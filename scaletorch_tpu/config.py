@@ -1,0 +1,256 @@
+"""Training configuration: composed dataclasses + CLI parsing.
+
+Parity with reference scaletorch/trainer/config.py:31-461 — eight argument
+dataclasses (Data/Model/Parallel/LrScheduler/Optimizer/Training/Checkpoint/
+Logging) composed by multiple inheritance into one ``ScaleTorchTPUArguments``
+parsed by HF ``HfArgumentParser`` (reference train.py:61-62). Validation
+invariants kept identical:
+
+  * every parallel dim >= 1; pp_engine in {"1f1b", "afab"} (config.py:155-173)
+  * seq_len % cp_size == 0 (config.py:425-433)
+  * global_batch_size == dp * micro_batch_size * grad_accum (config.py:435-439)
+  * world_size == dp * pp * cp * ep * tp (config.py:444-460)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class DataArguments:
+    dataset_name: Optional[str] = field(
+        default=None,
+        metadata={"help": "HF hub dataset name or local path (json/jsonl/dir)."},
+    )
+    dataset_text_key: str = field(
+        default="text", metadata={"help": "Column holding raw text."}
+    )
+    tokenizer_name_or_path: Optional[str] = field(
+        default=None, metadata={"help": "Tokenizer; defaults to model path."}
+    )
+    sequence_length: int = field(
+        default=1024, metadata={"help": "Training sequence length."}
+    )
+    tokenize_strategy: str = field(
+        default="concat_chunk",
+        metadata={"help": "Registered tokenize strategy (default concat+chunk)."},
+    )
+    num_proc: int = field(default=4, metadata={"help": "Tokenization processes."})
+    synthetic_data: bool = field(
+        default=False,
+        metadata={"help": "Use an on-device synthetic token stream (benchmarks)."},
+    )
+    synthetic_vocab_size: int = field(default=32000, metadata={"help": ""})
+
+
+@dataclass
+class ModelArguments:
+    model_name_or_path: Optional[str] = field(
+        default=None,
+        metadata={"help": "HF checkpoint dir/name to configure + load from."},
+    )
+    model_type: str = field(
+        default="llama",
+        metadata={"help": "llama | qwen3 | qwen3_moe | gpt_moe | lenet | mingpt"},
+    )
+    # Architecture overrides (used when model_name_or_path is unset).
+    hidden_size: int = 2048
+    intermediate_size: Optional[int] = None
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    vocab_size: int = 32000
+    max_position_embeddings: int = 32768
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-6
+    tie_word_embeddings: bool = False
+    attention_backend: str = field(
+        default="auto",
+        metadata={"help": "auto | flash | ring | sdpa — auto resolves like the "
+                          "reference (CP->ring, FLASH_ATTEN->flash, else sdpa)."},
+    )
+    # MoE knobs (qwen3_moe / gpt_moe)
+    num_experts: int = 8
+    num_experts_per_tok: int = 2
+    moe_intermediate_size: Optional[int] = None
+    moe_capacity_factor: float = 1.25
+    router_aux_loss_coef: float = 0.001
+    router_z_loss_coef: float = 0.0
+
+
+@dataclass
+class ParallelArguments:
+    data_parallel_size: int = field(default=1, metadata={"help": "DP degree."})
+    tensor_parallel_size: int = field(default=1, metadata={"help": "TP degree."})
+    pipeline_parallel_size: int = field(default=1, metadata={"help": "PP degree."})
+    context_parallel_size: int = field(default=1, metadata={"help": "CP degree."})
+    expert_parallel_size: int = field(default=1, metadata={"help": "EP degree."})
+    pp_engine: str = field(
+        default="1f1b", metadata={"help": "Pipeline schedule: 1f1b | afab."}
+    )
+    sequence_parallel: bool = field(
+        default=False, metadata={"help": "Megatron-style SP over the tp axis."}
+    )
+    num_microbatches: Optional[int] = field(
+        default=None,
+        metadata={"help": "PP microbatches; defaults to gradient_accumulation_steps."},
+    )
+
+    def __post_init__(self) -> None:
+        for name in (
+            "data_parallel_size",
+            "tensor_parallel_size",
+            "pipeline_parallel_size",
+            "context_parallel_size",
+            "expert_parallel_size",
+        ):
+            if getattr(self, name) < 1:
+                raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
+        if self.pp_engine not in ("1f1b", "afab"):
+            raise ValueError(f"pp_engine must be '1f1b' or 'afab', got {self.pp_engine!r}")
+        if self.sequence_parallel and self.tensor_parallel_size == 1:
+            raise ValueError("sequence_parallel requires tensor_parallel_size > 1")
+
+
+@dataclass
+class LrSchedulerArguments:
+    lr_scheduler_type: str = field(
+        default="cosine",
+        metadata={"help": "linear | cosine | polynomial | step | onecycle | constant"},
+    )
+    warmup_steps: int = 0
+    warmup_ratio: float = 0.0
+    min_lr_ratio: float = 0.1
+    step_size: int = 1000          # for 'step'
+    step_gamma: float = 0.9        # for 'step'
+    poly_power: float = 1.0        # for 'polynomial'
+
+
+@dataclass
+class OptimizerArguments:
+    optimizer_name: str = field(
+        default="adamw", metadata={"help": "adamw | adam | sgd | lamb | adafactor"}
+    )
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    adam_beta1: float = 0.9
+    adam_beta2: float = 0.95
+    adam_epsilon: float = 1e-8
+    max_grad_norm: float = 1.0
+    momentum: float = 0.9  # sgd
+
+
+@dataclass
+class TrainingArguments:
+    micro_batch_size: int = 1
+    gradient_accumulation_steps: int = 1
+    global_batch_size: Optional[int] = field(
+        default=None,
+        metadata={"help": "If set, must equal dp * micro_batch_size * grad_accum."},
+    )
+    total_train_steps: int = 100
+    seed: int = 42
+    dtype: str = field(default="bfloat16", metadata={"help": "bfloat16|float32"})
+    gradient_checkpointing: bool = field(
+        default=False, metadata={"help": "jax.checkpoint each decoder layer."}
+    )
+    donate_params: bool = field(
+        default=True, metadata={"help": "Donate param/opt buffers in the jitted step."}
+    )
+
+
+@dataclass
+class CheckpointArguments:
+    checkpoint_dir: Optional[str] = None
+    save_frequency: int = 0
+    resume_from_checkpoint: bool = False
+    async_checkpointing: bool = True
+    keep_n_checkpoints: int = 3
+
+
+@dataclass
+class LoggingArguments:
+    log_frequency: int = 1
+    log_file: Optional[str] = None
+    performance_log_dir: Optional[str] = None
+    verbose: bool = False
+
+
+@dataclass
+class ScaleTorchTPUArguments(
+    DataArguments,
+    ModelArguments,
+    ParallelArguments,
+    LrSchedulerArguments,
+    OptimizerArguments,
+    TrainingArguments,
+    CheckpointArguments,
+    LoggingArguments,
+):
+    """All training arguments, composed (reference config.py:393-403)."""
+
+    def __post_init__(self) -> None:
+        ParallelArguments.__post_init__(self)
+        if self.sequence_length % self.context_parallel_size != 0:
+            raise ValueError(
+                f"sequence_length {self.sequence_length} not divisible by "
+                f"context_parallel_size {self.context_parallel_size}"
+            )
+        expected_gbs = (
+            self.data_parallel_size
+            * self.micro_batch_size
+            * self.gradient_accumulation_steps
+        )
+        if self.global_batch_size is None:
+            self.global_batch_size = expected_gbs
+        elif self.global_batch_size != expected_gbs:
+            raise ValueError(
+                f"global_batch_size {self.global_batch_size} != dp * micro_bs * "
+                f"grad_accum = {expected_gbs}"
+            )
+        if self.num_microbatches is None:
+            self.num_microbatches = self.gradient_accumulation_steps
+
+    @property
+    def world_size(self) -> int:
+        return (
+            self.data_parallel_size
+            * self.pipeline_parallel_size
+            * self.context_parallel_size
+            * self.expert_parallel_size
+            * self.tensor_parallel_size
+        )
+
+    def validate_world_size(self, num_devices: int) -> None:
+        """Parity: reference config.py:444-460."""
+        if self.world_size != num_devices:
+            raise ValueError(
+                f"parallel dims product {self.world_size} != available device "
+                f"count {num_devices}"
+            )
+
+    def mesh_kwargs(self) -> dict:
+        return dict(
+            dp=self.data_parallel_size,
+            pp=self.pipeline_parallel_size,
+            cp=self.context_parallel_size,
+            ep=self.expert_parallel_size,
+            tp=self.tensor_parallel_size,
+        )
+
+
+def parse_args(args=None) -> ScaleTorchTPUArguments:
+    """CLI entry parsing, HfArgumentParser-style (reference train.py:61-62)."""
+    from transformers import HfArgumentParser
+
+    parser = HfArgumentParser(ScaleTorchTPUArguments)
+    (cfg,) = parser.parse_args_into_dataclasses(args=args)
+    return cfg
+
+
+def asdict_shallow(cfg) -> dict:
+    return dataclasses.asdict(cfg)

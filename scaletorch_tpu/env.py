@@ -1,0 +1,47 @@
+"""Centralised environment-variable registry.
+
+TPU-native counterpart of the reference's ``scaletorch/env.py:8-29``: a single
+place that declares every runtime toggle the framework reads, with defaults,
+so models/comms never reach for ``os.environ`` ad hoc.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable
+
+_REGISTRY: dict[str, tuple[str, Callable[[str], Any]]] = {}
+
+
+def _as_bool(v: str) -> bool:
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def register_env(name: str, default: str, parser: Callable[[str], Any] = str) -> None:
+    """Declare an environment variable the framework reads."""
+    _REGISTRY[name] = (default, parser)
+
+
+def get_env(name: str) -> Any:
+    """Read a registered environment variable, applying default + parser."""
+    if name not in _REGISTRY:
+        raise KeyError(f"env var {name!r} is not registered; call register_env first")
+    default, parser = _REGISTRY[name]
+    return parser(os.environ.get(name, default))
+
+
+def env_snapshot() -> dict[str, Any]:
+    """Current values of every registered env var (for logging/diagnostics)."""
+    return {k: get_env(k) for k in sorted(_REGISTRY)}
+
+
+# ---- core toggles (parity with reference scaletorch/env.py) -----------------
+register_env("FLASH_ATTEN", "1", _as_bool)          # use pallas flash attention
+register_env("CONTEXT_PARALLEL", "0", _as_bool)     # ring attention enabled
+register_env("SEQUENCE_PARALLEL", "0", _as_bool)    # Megatron-style SP on tp axis
+register_env("VERBOSE", "0", _as_bool)              # chatty comms logging
+register_env("DTYPE", "bfloat16", str)              # compute dtype
+# TPU-specific additions
+register_env("SCALETORCH_TPU_DEVICE_FLOPS", "", str)  # peak-FLOPS override
+register_env("SCALETORCH_TPU_MATMUL_PRECISION", "", str)
+register_env("SCALETORCH_TPU_DISABLE_PALLAS", "0", _as_bool)  # force XLA fallbacks

@@ -1,0 +1,9 @@
+"""Parallelism strategies over the 5D device mesh ``(dp, pp, cp, ep, tp)``."""
+
+from scaletorch_tpu.parallel.mesh import (  # noqa: F401
+    MESH_AXES,
+    MeshManager,
+    mesh_manager,
+    setup_mesh_manager,
+    reset_mesh_manager,
+)

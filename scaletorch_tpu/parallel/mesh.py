@@ -1,0 +1,236 @@
+"""5D device-mesh manager — the TPU-native ProcessGroupManager.
+
+The reference coordinates every parallel strategy through a
+``ProcessGroupManager`` that builds a 5D process grid
+``torch.arange(world).view(dp, pp, cp, ep, tp)`` and materialises seven
+families of torch.distributed groups (reference
+scaletorch/parallel/process_group.py:88-199). On TPU none of that group
+bookkeeping exists: one ``jax.sharding.Mesh`` with named axes
+``('dp', 'pp', 'cp', 'ep', 'tp')`` replaces all of it — XLA lowers
+collectives over any named axis (or tuple of axes, e.g. ``('cp', 'dp')``
+for the fused gradient-reduction group) directly onto ICI/DCN links.
+
+What survives from the reference is the *bookkeeping role*: axis sizes,
+global-rank decomposition, ring neighbours for CP, and previous/next stage
+for PP. Those are pure functions here, unit-testable exactly like the
+reference tests its grid math (reference tests/parallel/test_process_group.py).
+
+Rank semantics: ``coords``/``rank_of`` decompose a **logical rank** — the
+row-major position in the ``(dp, pp, cp, ep, tp)`` grid with TP
+fastest-varying, matching the reference's decomposition order
+(process_group.py:94-102). Logical ranks drive schedules, ring
+permutations, and checkpoint naming; they deliberately do NOT promise to
+equal ``jax.devices()`` enumeration indices, because ``jax.make_mesh``
+may reorder devices for ICI-torus friendliness. Use ``device_at`` to get
+the physical device behind a logical coordinate.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# Axis order matters: last axis (tp) is fastest-varying, matching the
+# reference grid view(dp, pp, cp, ep, tp) (process_group.py:89-91).
+MESH_AXES: tuple[str, ...] = ("dp", "pp", "cp", "ep", "tp")
+
+# Fused axis tuples used for gradient reduction and loss averaging, mirroring
+# the reference's cp_dp_group / pp_dp_group fused groups (process_group.py:125-199).
+DATA_AXES: tuple[str, ...] = ("dp", "cp")  # gradient all-reduce group (cp_dp_group)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshCoords:
+    """Coordinates of one device in the 5D grid."""
+
+    dp: int
+    pp: int
+    cp: int
+    ep: int
+    tp: int
+
+    def as_tuple(self) -> tuple[int, int, int, int, int]:
+        return (self.dp, self.pp, self.cp, self.ep, self.tp)
+
+
+class MeshManager:
+    """Axis sizes + grid math + the ``jax.sharding.Mesh`` itself.
+
+    Unlike the reference's per-rank ``ProcessGroupManager`` (which stores
+    *this process's* coordinates), a MeshManager is rank-agnostic: under
+    SPMD every host runs the same program and per-device coordinates are
+    obtained *inside* ``shard_map`` via ``jax.lax.axis_index``. The
+    rank-math methods here are pure helpers used by schedules, checkpoint
+    naming, and tests.
+    """
+
+    def __init__(
+        self,
+        tp: int = 1,
+        cp: int = 1,
+        pp: int = 1,
+        dp: int = 1,
+        ep: int = 1,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ) -> None:
+        for name, size in (("tp", tp), ("cp", cp), ("pp", pp), ("dp", dp), ("ep", ep)):
+            if size < 1:
+                raise ValueError(f"{name} size must be >= 1, got {size}")
+        self.tp, self.cp, self.pp, self.dp, self.ep = tp, cp, pp, dp, ep
+        self._devices = list(devices) if devices is not None else list(jax.devices())
+        world = self.world_size
+        if world != len(self._devices):
+            raise ValueError(
+                f"mesh dims dp*pp*cp*ep*tp = {self.dp}*{self.pp}*{self.cp}*"
+                f"{self.ep}*{self.tp} = {world} != device count {len(self._devices)}"
+            )
+        if devices is None:
+            # Let JAX pick an ICI-friendly assignment of logical mesh axes to
+            # the physical torus (this may reorder devices relative to
+            # jax.devices() enumeration — see module docstring).
+            self._mesh = jax.make_mesh(self.shape, MESH_AXES)
+        else:
+            # Explicit device list: caller controls placement; honour their
+            # order exactly (used by tests and multi-process setups that
+            # pre-arrange devices).
+            import numpy as np
+
+            self._mesh = Mesh(np.asarray(self._devices).reshape(self.shape), MESH_AXES)
+
+    # ---- sizes --------------------------------------------------------------
+    @property
+    def shape(self) -> tuple[int, int, int, int, int]:
+        return (self.dp, self.pp, self.cp, self.ep, self.tp)
+
+    @property
+    def world_size(self) -> int:
+        return math.prod(self.shape)
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    def axis_size(self, axis: str) -> int:
+        return dict(zip(MESH_AXES, self.shape))[axis]
+
+    # ---- rank decomposition (parity: process_group.py:94-102) ---------------
+    def coords(self, rank: int) -> MeshCoords:
+        """Decompose a global rank; TP fastest, then EP, CP, PP, DP."""
+        if not 0 <= rank < self.world_size:
+            raise ValueError(f"rank {rank} out of range [0, {self.world_size})")
+        tp_rank = rank % self.tp
+        ep_rank = (rank // self.tp) % self.ep
+        cp_rank = (rank // (self.tp * self.ep)) % self.cp
+        pp_rank = (rank // (self.tp * self.ep * self.cp)) % self.pp
+        dp_rank = rank // (self.tp * self.ep * self.cp * self.pp)
+        return MeshCoords(dp=dp_rank, pp=pp_rank, cp=cp_rank, ep=ep_rank, tp=tp_rank)
+
+    def rank_of(self, coords: MeshCoords) -> int:
+        c = coords
+        return (
+            ((((c.dp * self.pp) + c.pp) * self.cp + c.cp) * self.ep + c.ep) * self.tp
+            + c.tp
+        )
+
+    # ---- ring / stage neighbours -------------------------------------------
+    # CP ring: rank r sends K/V to (r+1) % cp and receives from (r-1) % cp,
+    # matching reference cp_send_rank/cp_recv_rank (process_group.py:235-240).
+    def cp_send_rank(self, cp_rank: int) -> int:
+        return (cp_rank + 1) % self.cp
+
+    def cp_recv_rank(self, cp_rank: int) -> int:
+        return (cp_rank - 1) % self.cp
+
+    def cp_ring_permutation(self) -> list[tuple[int, int]]:
+        """(source, dest) pairs along the cp axis for ``lax.ppermute``."""
+        return [(i, (i + 1) % self.cp) for i in range(self.cp)]
+
+    # PP chain: stage s feeds s+1; matching pp_next_rank/pp_prev_rank
+    # (process_group.py:261-285). Edges return None (no wraparound).
+    def pp_next_rank(self, pp_rank: int) -> Optional[int]:
+        return pp_rank + 1 if pp_rank < self.pp - 1 else None
+
+    def pp_prev_rank(self, pp_rank: int) -> Optional[int]:
+        return pp_rank - 1 if pp_rank > 0 else None
+
+    def pp_is_first_stage(self, pp_rank: int) -> bool:
+        return pp_rank == 0
+
+    def pp_is_last_stage(self, pp_rank: int) -> bool:
+        return pp_rank == self.pp - 1
+
+    def pp_fwd_permutation(self) -> list[tuple[int, int]]:
+        """(source, dest) stage pairs for forward activations (no wrap)."""
+        return [(i, i + 1) for i in range(self.pp - 1)]
+
+    def pp_bwd_permutation(self) -> list[tuple[int, int]]:
+        return [(i + 1, i) for i in range(self.pp - 1)]
+
+    # ---- physical devices ---------------------------------------------------
+    def device_at(self, coords: MeshCoords) -> jax.Device:
+        """Physical device behind a logical grid coordinate."""
+        return self._mesh.devices[coords.as_tuple()]
+
+    # ---- sharding helpers ---------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (
+            f"MeshManager(dp={self.dp}, pp={self.pp}, cp={self.cp}, "
+            f"ep={self.ep}, tp={self.tp}, world={self.world_size})"
+        )
+
+
+# ---- global singleton (parity: ProcessGroupManagerProxy, process_group.py:359-405)
+_instance: Optional[MeshManager] = None
+
+
+class _MeshManagerProxy:
+    """Module-level handle that resolves to the configured MeshManager.
+
+    Mirrors the reference's global ``process_group_manager`` proxy with
+    ``__bool__`` reporting whether setup has run (process_group.py:359-384),
+    so library code can write ``if mesh_manager: ...``.
+    """
+
+    def __getattr__(self, name: str):
+        if _instance is None:
+            raise RuntimeError(
+                "MeshManager not initialised; call setup_mesh_manager(...) first"
+            )
+        return getattr(_instance, name)
+
+    def __bool__(self) -> bool:
+        return _instance is not None
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return repr(_instance) if _instance is not None else "MeshManager(<unset>)"
+
+
+mesh_manager = _MeshManagerProxy()
+
+
+def setup_mesh_manager(
+    tp: int = 1,
+    cp: int = 1,
+    pp: int = 1,
+    dp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> MeshManager:
+    global _instance
+    _instance = MeshManager(tp=tp, cp=cp, pp=pp, dp=dp, ep=ep, devices=devices)
+    return _instance
+
+
+def reset_mesh_manager() -> None:
+    global _instance
+    _instance = None

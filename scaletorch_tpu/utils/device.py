@@ -1,0 +1,93 @@
+"""TPU device abstraction: kind probing, peak-FLOPS registry, memory stats.
+
+TPU-native counterpart of the reference's device layer
+(scaletorch/utils/device.py:24-298). The reference multiplexes over
+cuda/npu/mlu/musa vendor plugins; on JAX there is one backend API, so this
+module keeps only the parts with behavioural weight: the **peak bf16 FLOPS
+registry** used for MFU accounting (reference device.py:214-231, with env
+override SCALETORCH_DEVICE_FLOPS :234 and register_device_flops :237) and
+live device memory statistics (reference memory_* helpers).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+# Peak dense bf16 FLOP/s per chip, by substring of jax.Device.device_kind.
+# TPU numbers are public spec-sheet values; GPU/NPU entries retained for
+# CPU-hosted comparison plots and parity with the reference table
+# (reference device.py:214-231: 910B=320T, A100=312T, H100=1979T ...).
+_DEVICE_FLOPS: dict[str, float] = {
+    # TPUs (dense bf16, per chip)
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5p": 459e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+    # GPUs / NPUs, for cross-hardware MFU comparisons
+    "h100": 1979e12 / 2,  # dense (spec sheet is sparse) bf16
+    "a100": 312e12,
+    "910b": 320e12,
+    "910": 256e12,
+    # CPU fallback so MFU math never divides by zero in tests
+    "cpu": 1e12,
+}
+
+
+
+def register_device_flops(kind_substring: str, flops: float) -> None:
+    """Extend the registry (parity: reference device.py:237)."""
+    _DEVICE_FLOPS[kind_substring.lower()] = float(flops)
+
+
+def get_device_kind(device: Optional[jax.Device] = None) -> str:
+    device = device or jax.devices()[0]
+    return device.device_kind
+
+
+def get_theoretical_flops(device: Optional[jax.Device] = None) -> float:
+    """Peak dense bf16 FLOP/s for one chip.
+
+    Resolution order: env override -> registry substring match -> cpu
+    fallback (reference device.py:234 has the same env-first order).
+    """
+    from scaletorch_tpu.env import get_env
+
+    override = get_env("SCALETORCH_TPU_DEVICE_FLOPS")
+    if override:
+        return float(override)
+    kind = get_device_kind(device).lower()
+    for sub, flops in _DEVICE_FLOPS.items():
+        if sub in kind:
+            return flops
+    return _DEVICE_FLOPS["cpu"]
+
+
+def device_memory_stats(device: Optional[jax.Device] = None) -> dict[str, float]:
+    """Live per-device memory statistics in bytes.
+
+    Maps the reference's memory_allocated/reserved/max_memory_* helpers onto
+    jax.Device.memory_stats() (TPU backends report bytes_in_use /
+    peak_bytes_in_use / bytes_limit; CPU returns {}).
+    """
+    device = device or jax.devices()[0]
+    stats = device.memory_stats() or {}
+    return {
+        "bytes_in_use": float(stats.get("bytes_in_use", 0)),
+        "peak_bytes_in_use": float(stats.get("peak_bytes_in_use", 0)),
+        "bytes_limit": float(stats.get("bytes_limit", 0)),
+    }
+
+
+def is_tpu() -> bool:
+    return jax.devices()[0].platform == "tpu"
+
+
+def bf16_supported() -> bool:
+    """bf16 is native on every TPU generation and on CPU via XLA."""
+    return True

@@ -1,0 +1,85 @@
+"""Process-aware colored logger.
+
+Counterpart of reference scaletorch/utils/logger_utils.py:18-140: a colored
+formatter carrying the process index, with the main process logging at INFO
+to stdout (+ optional file) and every other host ERROR-only, so multi-host
+launches don't interleave N copies of every line.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Optional
+
+_COLORS = {
+    logging.DEBUG: "\x1b[36m",     # cyan
+    logging.INFO: "\x1b[32m",      # green
+    logging.WARNING: "\x1b[33m",   # yellow
+    logging.ERROR: "\x1b[31m",     # red
+    logging.CRITICAL: "\x1b[35m",  # magenta
+}
+_RESET = "\x1b[0m"
+
+
+class ColorfulFormatter(logging.Formatter):
+    def __init__(self, process_index: int, use_color: bool = True) -> None:
+        super().__init__()
+        self.process_index = process_index
+        self.use_color = use_color
+
+    def format(self, record: logging.LogRecord) -> str:
+        level = record.levelname
+        if self.use_color:
+            color = _COLORS.get(record.levelno, "")
+            level = f"{color}{level}{_RESET}"
+        prefix = (
+            f"[{self.formatTime(record, '%Y-%m-%d %H:%M:%S')}]"
+            f"[proc {self.process_index}][{level}]"
+        )
+        return f"{prefix} {record.getMessage()}"
+
+
+def get_logger(
+    name: str = "scaletorch_tpu",
+    log_file: Optional[str] = None,
+    level: int = logging.INFO,
+) -> logging.Logger:
+    logger = logging.getLogger(name)
+    configured = getattr(logger, "_scaletorch_configured", False)
+    # Re-configure when the caller asks for something the cached setup lacks
+    # (e.g. the trainer passing log_file after library modules grabbed the
+    # bare logger at import time).
+    wants_file = log_file is not None and log_file not in getattr(
+        logger, "_scaletorch_log_files", set()
+    )
+    if configured and not wants_file:
+        return logger
+
+    try:
+        import jax
+
+        process_index = jax.process_index()
+    except Exception:
+        process_index = 0
+
+    logger.setLevel(level if process_index == 0 else logging.ERROR)
+    logger.propagate = False
+
+    if not configured:
+        use_color = sys.stdout.isatty() and os.environ.get("NO_COLOR") is None
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(ColorfulFormatter(process_index, use_color))
+        logger.addHandler(handler)
+        logger._scaletorch_log_files = set()  # type: ignore[attr-defined]
+
+    if wants_file and process_index == 0:
+        os.makedirs(os.path.dirname(os.path.abspath(log_file)), exist_ok=True)
+        fh = logging.FileHandler(log_file)
+        fh.setFormatter(ColorfulFormatter(process_index, use_color=False))
+        logger.addHandler(fh)
+        logger._scaletorch_log_files.add(log_file)  # type: ignore[attr-defined]
+
+    logger._scaletorch_configured = True  # type: ignore[attr-defined]
+    return logger

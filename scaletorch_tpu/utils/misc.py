@@ -1,0 +1,98 @@
+"""Misc numerics: MFU accounting, parameter counting, seeds, formatting.
+
+Parity targets from reference scaletorch/utils/misc.py:51-249, most
+importantly the MFU formula (misc.py:136-174) — kept identical so MFU
+numbers are directly comparable with the reference's benchmark tables:
+
+    flops_per_token = 6 * N + 12 * L * H * Dh * S
+
+(6 FLOPs per param per token for fwd+bwd matmuls, plus attention-score
+FLOPs 12·layers·heads·head_dim·seq).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scaletorch_tpu.utils.device import get_theoretical_flops
+
+
+def set_all_seed(seed: int) -> jax.Array:
+    """Seed python/numpy and return a jax PRNG key (the jax-native seed)."""
+    random.seed(seed)
+    np.random.seed(seed)
+    return jax.random.PRNGKey(seed)
+
+
+def to_readable_format(num: float, precision: int = 2) -> str:
+    """1234567 -> '1.23M' (parity: reference misc.py:109-133)."""
+    for div, suffix in ((1e12, "T"), (1e9, "B"), (1e6, "M"), (1e3, "K")):
+        if abs(num) >= div:
+            return f"{num / div:.{precision}f}{suffix}"
+    return f"{num:.{precision}f}"
+
+
+def get_num_params(params: Any) -> int:
+    """Total scalar count of a parameter pytree."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
+
+
+def get_flops_per_token(
+    num_params: int,
+    num_layers: int,
+    num_heads: int,
+    head_dim: int,
+    seq_len: int,
+) -> float:
+    """Identical formula to reference misc.py:171 for comparable MFU."""
+    return 6.0 * num_params + 12.0 * num_layers * num_heads * head_dim * seq_len
+
+
+def get_mfu(
+    tokens_per_second: float,
+    num_params: int,
+    num_layers: int,
+    num_heads: int,
+    head_dim: int,
+    seq_len: int,
+    num_chips: int = 1,
+    peak_flops: Optional[float] = None,
+) -> float:
+    """Model FLOPs Utilisation in percent (0-100)."""
+    if peak_flops is None:
+        peak_flops = get_theoretical_flops()
+    flops_per_token = get_flops_per_token(
+        num_params, num_layers, num_heads, head_dim, seq_len
+    )
+    achieved = tokens_per_second * flops_per_token
+    return 100.0 * achieved / (peak_flops * num_chips)
+
+
+def average_loss_across_data_ranks(loss: jax.Array, mesh_axes=None) -> jax.Array:
+    """Inside shard_map: mean loss over the fused (dp, cp) group.
+
+    Parity: reference average_loss_across_dp_cp_ranks (misc.py:229-249),
+    which all-reduces on cp_dp_group. Call only inside shard_map bodies.
+    """
+    if mesh_axes is None:
+        from scaletorch_tpu.parallel.mesh import DATA_AXES
+
+        mesh_axes = DATA_AXES
+    return jax.lax.pmean(loss, mesh_axes)
+
+
+def tree_bytes(params: Any) -> int:
+    return sum(x.size * x.dtype.itemsize for x in jax.tree_util.tree_leaves(params))
+
+
+def assert_all_finite(tree: Any, name: str = "tree") -> None:
+    """Debug helper: raise if any leaf contains nan/inf (host-side)."""
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        arr = np.asarray(leaf)
+        if not np.isfinite(arr).all():
+            raise FloatingPointError(f"non-finite values in {name}{jax.tree_util.keystr(path)}")

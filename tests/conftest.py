@@ -1,0 +1,59 @@
+"""Test bootstrap: fake an 8-device TPU pod with virtual CPU devices.
+
+The reference tests multi-rank behaviour single-process by mocking
+torch.distributed (reference tests/conftest.py:24-42). The JAX-native
+equivalent is better: run the *real* collectives on 8 virtual CPU devices
+via ``--xla_force_host_platform_device_count=8`` (SURVEY.md §4), so every
+shard_map/ppermute/psum path is executed, not mocked.
+
+Env vars must be set before jax initialises its backends, hence the
+module-level block ahead of any jax import.
+"""
+
+import os
+
+# Force the CPU platform (the sandbox registers an 'axon' TPU platform via
+# sitecustomize; JAX_PLATFORMS=cpu makes jax select cpu regardless).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The sandbox's sitecustomize may have imported jax already (latching
+# JAX_PLATFORMS at import time), so update the live config too.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from scaletorch_tpu.parallel import mesh as mesh_mod  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_mesh_manager():
+    """Restore the global mesh singleton per test (parity: reference
+    tests/conftest.py:14-21 reset_pgm)."""
+    yield
+    mesh_mod.reset_mesh_manager()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+def make_mesh_manager(**kwargs):
+    return mesh_mod.setup_mesh_manager(**kwargs)
+
+
+@pytest.fixture
+def mm_factory(devices8):
+    """Factory fixture: build a MeshManager with arbitrary 5D geometry
+    (parity: reference mock_pgm factory, tests/conftest.py:78-102)."""
+    return make_mesh_manager

@@ -1,0 +1,83 @@
+"""Config validation — parity with reference config.py __post_init__ checks."""
+
+import pytest
+
+from scaletorch_tpu.config import (
+    ParallelArguments,
+    ScaleTorchTPUArguments,
+    parse_args,
+)
+
+
+class TestParallelArguments:
+    def test_defaults_ok(self):
+        pa = ParallelArguments()
+        assert pa.pp_engine == "1f1b"
+
+    def test_bad_dim(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            ParallelArguments(tensor_parallel_size=0)
+
+    def test_bad_engine(self):
+        with pytest.raises(ValueError, match="pp_engine"):
+            ParallelArguments(pp_engine="gpipe")
+
+    def test_sp_requires_tp(self):
+        with pytest.raises(ValueError, match="sequence_parallel"):
+            ParallelArguments(sequence_parallel=True, tensor_parallel_size=1)
+
+
+class TestComposedArguments:
+    def test_seq_divisible_by_cp(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            ScaleTorchTPUArguments(sequence_length=1023, context_parallel_size=2)
+
+    def test_global_batch_size_autofill(self):
+        cfg = ScaleTorchTPUArguments(
+            data_parallel_size=2,
+            micro_batch_size=3,
+            gradient_accumulation_steps=4,
+        )
+        assert cfg.global_batch_size == 24
+
+    def test_global_batch_size_mismatch(self):
+        with pytest.raises(ValueError, match="global_batch_size"):
+            ScaleTorchTPUArguments(
+                data_parallel_size=2, micro_batch_size=2, global_batch_size=5
+            )
+
+    def test_world_size(self):
+        cfg = ScaleTorchTPUArguments(
+            data_parallel_size=2,
+            tensor_parallel_size=2,
+            context_parallel_size=2,
+        )
+        assert cfg.world_size == 8
+        cfg.validate_world_size(8)
+        with pytest.raises(ValueError, match="device count"):
+            cfg.validate_world_size(4)
+
+    def test_num_microbatches_default(self):
+        cfg = ScaleTorchTPUArguments(gradient_accumulation_steps=7)
+        assert cfg.num_microbatches == 7
+
+    def test_mesh_kwargs(self):
+        cfg = ScaleTorchTPUArguments(tensor_parallel_size=4, data_parallel_size=2)
+        assert cfg.mesh_kwargs() == dict(dp=2, pp=1, cp=1, ep=1, tp=4)
+
+
+class TestCliParsing:
+    def test_parse_args_roundtrip(self):
+        cfg = parse_args(
+            [
+                "--tensor_parallel_size", "2",
+                "--data_parallel_size", "4",
+                "--sequence_length", "2048",
+                "--learning_rate", "1e-3",
+                "--pp_engine", "afab",
+            ]
+        )
+        assert cfg.tensor_parallel_size == 2
+        assert cfg.world_size == 8
+        assert cfg.learning_rate == 1e-3
+        assert cfg.pp_engine == "afab"

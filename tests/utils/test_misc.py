@@ -1,0 +1,50 @@
+"""MFU math, formatting, device registry."""
+
+import jax.numpy as jnp
+import pytest
+
+from scaletorch_tpu.utils.device import (
+    get_theoretical_flops,
+    register_device_flops,
+)
+from scaletorch_tpu.utils.misc import (
+    get_flops_per_token,
+    get_mfu,
+    get_num_params,
+    to_readable_format,
+)
+
+
+class TestReadableFormat:
+    def test_scales(self):
+        assert to_readable_format(1_234) == "1.23K"
+        assert to_readable_format(1_234_567) == "1.23M"
+        assert to_readable_format(1.5e9) == "1.50B"
+        assert to_readable_format(2e12) == "2.00T"
+        assert to_readable_format(42) == "42.00"
+
+
+class TestMfu:
+    def test_flops_per_token_formula(self):
+        # Must match the reference formula 6N + 12·L·H·Dh·S (misc.py:171)
+        # so MFU numbers are comparable with BASELINE.md.
+        n, l, h, d, s = 600e6, 28, 16, 128, 4096
+        assert get_flops_per_token(n, l, h, d, s) == 6 * n + 12 * l * h * d * s
+
+    def test_mfu_env_override(self, monkeypatch):
+        monkeypatch.setenv("SCALETORCH_TPU_DEVICE_FLOPS", "1e12")
+        # 1 param model, no attention: 6 flops/token; 1e11 tok/s -> 6e11 flops
+        mfu = get_mfu(1e11, 1, 0, 0, 0, 1)
+        assert mfu == pytest.approx(60.0)
+
+    def test_register_device_flops(self, monkeypatch):
+        monkeypatch.delenv("SCALETORCH_TPU_DEVICE_FLOPS", raising=False)
+        register_device_flops("cpu", 5e12)
+        assert get_theoretical_flops() == 5e12
+        register_device_flops("cpu", 1e12)  # restore
+
+
+class TestNumParams:
+    def test_counts_pytree(self):
+        params = {"a": jnp.ones((2, 3)), "b": {"c": jnp.ones((4,))}}
+        assert get_num_params(params) == 10
