@@ -1,0 +1,10 @@
+"""Data pipeline: tokenization strategies + micro-batch CP-aware loading."""
+
+from scaletorch_tpu.data.dataset import (  # noqa: F401
+    DatasetProcessor,
+    register_tokenize_strategy,
+)
+from scaletorch_tpu.data.dataloader import (  # noqa: F401
+    MicroBatchDataLoader,
+    SyntheticDataLoader,
+)

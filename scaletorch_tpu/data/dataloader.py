@@ -1,0 +1,155 @@
+"""Micro-batch data loading for the SPMD train step.
+
+Parity with reference scaletorch/data/dataloader.py:16-292
+(MicroBatchDataLoader): global batch = micro_bs x grad_accum x dp
+(:107-109), shifted next-token targets + absolute position ids (:119-233),
+seeded shuffling with epoch bump (DistributedSampler parity, :170-186,255-258),
+drop_last semantics.
+
+TPU-native difference: the reference's per-rank collate slices the sequence
+for this cp_rank and samples for this dp_rank, because every process feeds
+only its own device. Under JAX's single-controller SPMD the loader yields
+the **global** step batch ``[accum, dp * micro_bs, seq]`` and the jitted
+step's input sharding ``P(None, 'dp', 'cp')`` performs exactly that
+dp-scatter and contiguous cp sequence-slicing on device — same placement,
+no host-side bookkeeping. (Multi-host feeding uses
+``jax.make_array_from_process_local_data`` with per-process shards; see
+trainer.) Position ids stay absolute and global, as CP requires
+(reference dataloader.py:222-233).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+Batch = Dict[str, np.ndarray]
+
+
+class MicroBatchDataLoader:
+    """Yields per-optimizer-step batches from a [N, seq+1] token array."""
+
+    def __init__(
+        self,
+        tokens: np.ndarray,  # [N, seq_len + 1] int32
+        micro_batch_size: int,
+        gradient_accumulation_steps: int,
+        data_parallel_size: int = 1,
+        seed: int = 42,
+        shuffle: bool = True,
+    ) -> None:
+        if tokens.ndim != 2:
+            raise ValueError(f"tokens must be [N, seq_len+1], got {tokens.shape}")
+        self.tokens = tokens
+        self.seq_len = tokens.shape[1] - 1
+        self.micro_batch_size = micro_batch_size
+        self.grad_accum = gradient_accumulation_steps
+        self.dp = data_parallel_size
+        self.global_batch_size = micro_batch_size * data_parallel_size
+        self.samples_per_step = self.global_batch_size * self.grad_accum
+        self.seed = seed
+        self.shuffle = shuffle
+        # A full optimizer-step batch is the minimum unit; the ragged tail of
+        # an epoch is always dropped (reference DistributedSampler
+        # drop_last=True semantics — partial step batches are not supported).
+        if len(tokens) < self.samples_per_step:
+            raise ValueError(
+                f"dataset has {len(tokens)} sequences < {self.samples_per_step} "
+                f"needed per step"
+            )
+        self.epoch = 0
+        self._step_offset = 0  # intra-epoch resume position
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.samples_per_step * self.seq_len
+
+    def steps_per_epoch(self) -> int:
+        return len(self.tokens) // self.samples_per_step
+
+    def _epoch_order(self) -> np.ndarray:
+        if not self.shuffle:
+            return np.arange(len(self.tokens))
+        # Epoch-dependent seeding = DistributedSampler.set_epoch parity.
+        rng = np.random.default_rng(self.seed + self.epoch)
+        return rng.permutation(len(self.tokens))
+
+    def set_state(self, steps_consumed: int) -> None:
+        """Fast-forward to just after ``steps_consumed`` optimizer steps —
+        checkpoint-resume parity with the reference's sampler epoch bump +
+        restored step counters (reference train.py:195-218). Index-only:
+        no data is touched."""
+        spe = self.steps_per_epoch()
+        self.epoch = steps_consumed // spe
+        self._step_offset = steps_consumed % spe
+
+    def __iter__(self) -> Iterator[Batch]:
+        """Infinite iterator over optimizer-step batches, cycling epochs."""
+        while True:
+            order = self._epoch_order()
+            start = self._step_offset
+            self._step_offset = 0
+            for i in range(start, self.steps_per_epoch()):
+                idx = order[i * self.samples_per_step : (i + 1) * self.samples_per_step]
+                chunk = self.tokens[idx]  # [samples, seq+1]
+                yield self._collate(chunk)
+            self.epoch += 1
+
+    def _collate(self, chunk: np.ndarray) -> Batch:
+        a, g, s = self.grad_accum, self.global_batch_size, self.seq_len
+        inputs = chunk[:, :-1].reshape(a, g, s)
+        targets = chunk[:, 1:].reshape(a, g, s)
+        # position_ids carry the accumulation axis so the train step's scan
+        # can slice every leaf uniformly; each row is absolute 0..seq-1.
+        return {
+            "input_ids": np.ascontiguousarray(inputs, dtype=np.int32),
+            "target_ids": np.ascontiguousarray(targets, dtype=np.int32),
+            "position_ids": np.broadcast_to(
+                np.arange(s, dtype=np.int32), (a, s)
+            ).copy(),
+        }
+
+
+class SyntheticDataLoader:
+    """On-host random token stream with the same batch contract — the
+    benchmark path (reference benchmarks feed real data; synthetic keeps
+    bench.py hermetic)."""
+
+    def __init__(
+        self,
+        vocab_size: int,
+        sequence_length: int,
+        micro_batch_size: int,
+        gradient_accumulation_steps: int,
+        data_parallel_size: int = 1,
+        seed: int = 0,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.seq_len = sequence_length
+        self.micro_batch_size = micro_batch_size
+        self.grad_accum = gradient_accumulation_steps
+        self.dp = data_parallel_size
+        self.global_batch_size = micro_batch_size * data_parallel_size
+        self.rng = np.random.default_rng(seed)
+
+    @property
+    def tokens_per_step(self) -> int:
+        return self.grad_accum * self.global_batch_size * self.seq_len
+
+    def __iter__(self) -> Iterator[Batch]:
+        while True:
+            toks = self.rng.integers(
+                0,
+                self.vocab_size,
+                size=(self.grad_accum, self.global_batch_size, self.seq_len + 1),
+                dtype=np.int32,
+            )
+            yield {
+                "input_ids": toks[:, :, :-1],
+                "target_ids": toks[:, :, 1:],
+                "position_ids": np.broadcast_to(
+                    np.arange(self.seq_len, dtype=np.int32),
+                    (self.grad_accum, self.seq_len),
+                ).copy(),
+            }

@@ -1,0 +1,106 @@
+"""Dataset tokenization: strategy registry + concat-chunk default.
+
+Parity with reference scaletorch/data/dataset.py:28-88: a
+``register_tokenize_strategy`` registry whose default ``concat_chunk``
+strategy concatenates all document tokens and cuts the stream into
+``seq_len + 1`` chunks (each yields seq_len inputs + shifted targets), and
+a ``DatasetProcessor`` wrapping tokenizer init + HF ``load_dataset`` +
+multiprocess ``.map`` tokenization.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+_STRATEGIES: Dict[str, Callable] = {}
+
+
+def register_tokenize_strategy(name: str, fn: Callable = None):
+    """Register ``strategy(examples, tokenizer, seq_len, text_key) -> dict``.
+
+    The strategy receives a batch of raw examples and returns
+    ``{"input_ids": [[seq_len + 1 tokens], ...]}``.
+    """
+
+    def _register(f):
+        _STRATEGIES[name] = f
+        return f
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+@register_tokenize_strategy("concat_chunk")
+def concat_chunk(examples, tokenizer, seq_len: int, text_key: str = "text"):
+    """Concat every document's tokens (+ eos), cut into seq_len+1 chunks,
+    drop the ragged tail (reference dataset.py:64-88)."""
+    eos = tokenizer.eos_token_id
+    stream: list[int] = []
+    for text in examples[text_key]:
+        toks = tokenizer(text, add_special_tokens=False)["input_ids"]
+        stream.extend(toks)
+        if eos is not None:
+            stream.append(eos)
+    chunk = seq_len + 1
+    n = (len(stream) // chunk) * chunk
+    chunks = [stream[i : i + chunk] for i in range(0, n, chunk)]
+    return {"input_ids": chunks}
+
+
+def get_tokenize_strategy(name: str) -> Callable:
+    if name not in _STRATEGIES:
+        raise KeyError(f"unknown tokenize strategy {name!r}; have {sorted(_STRATEGIES)}")
+    return _STRATEGIES[name]
+
+
+class DatasetProcessor:
+    """Tokenizer + dataset loading + strategy-driven tokenization
+    (reference dataset.py:89+)."""
+
+    def __init__(
+        self,
+        tokenizer_name_or_path: str,
+        sequence_length: int,
+        tokenize_strategy: str = "concat_chunk",
+        text_key: str = "text",
+        num_proc: int = 4,
+    ) -> None:
+        from transformers import AutoTokenizer
+
+        self.tokenizer = AutoTokenizer.from_pretrained(tokenizer_name_or_path)
+        self.sequence_length = sequence_length
+        self.strategy = get_tokenize_strategy(tokenize_strategy)
+        self.text_key = text_key
+        self.num_proc = num_proc
+
+    def load(self, dataset_name: str, split: str = "train"):
+        """Local json/jsonl path, local dir, or hub name
+        (reference pretrain_dataset.py:13-107)."""
+        import datasets as hf_datasets
+
+        if os.path.isfile(dataset_name) and dataset_name.endswith((".json", ".jsonl")):
+            return hf_datasets.load_dataset("json", data_files=dataset_name)[split]
+        return hf_datasets.load_dataset(dataset_name, split=split)
+
+    def tokenize(self, dataset):
+        """Map the strategy over the dataset, dropping raw columns."""
+        return dataset.map(
+            lambda ex: self.strategy(
+                ex, self.tokenizer, self.sequence_length, self.text_key
+            ),
+            batched=True,
+            remove_columns=dataset.column_names,
+            num_proc=self.num_proc if len(dataset) > 1000 else None,
+        )
+
+    def process(self, dataset_name: str, split: str = "train"):
+        return self.tokenize(self.load(dataset_name, split))
+
+
+def chunks_to_array(dataset) -> np.ndarray:
+    """Tokenized dataset -> [N, seq_len+1] int32 array."""
+    return np.asarray(dataset["input_ids"], dtype=np.int32)

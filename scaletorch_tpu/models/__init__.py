@@ -1,0 +1,18 @@
+"""Model zoo: functional JAX models (params pytree + pure apply).
+
+Parity with reference scaletorch/models/__init__.py:1-9 — Llama, Qwen3,
+Qwen3-MoE, GPT(MoE), LeNet, plus the standalone attention-variant library
+(MHA/MQA/GQA/MLA) and the attention backend registry.
+"""
+
+from scaletorch_tpu.models.registry import (  # noqa: F401
+    get_attention_backend,
+    register_attention_backend,
+    resolve_attention_backend,
+)
+from scaletorch_tpu.models.llama import Llama, LlamaConfig  # noqa: F401
+from scaletorch_tpu.models.qwen3 import Qwen3, Qwen3Config  # noqa: F401
+
+# Register the non-default attention backends (flash; ring arrives with the
+# context-parallel module).
+import scaletorch_tpu.ops  # noqa: E402,F401

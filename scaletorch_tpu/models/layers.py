@@ -1,0 +1,180 @@
+"""Shared model building blocks: RMSNorm, RoPE, SDPA attention, initializers.
+
+Functional counterparts of reference scaletorch/models/attention_utils.py:
+RMSNorm computed internally in fp32 (:247-271), RoPE ``get_cos_sin`` /
+``apply_rotary_pos_emb`` (:170-239), fan-in uniform ``_init_weights``
+(:160-167). All functions are pure and jit/scan-friendly (static shapes,
+no Python control flow on traced values).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+# ---- initialisation ---------------------------------------------------------
+def fan_in_uniform(key: jax.Array, shape: Tuple[int, ...], fan_in: int,
+                   dtype=jnp.float32) -> jax.Array:
+    """U(-1/sqrt(fan_in), 1/sqrt(fan_in)) — the reference's Linear init
+    (attention_utils.py:160-167)."""
+    bound = 1.0 / math.sqrt(fan_in)
+    return jax.random.uniform(key, shape, dtype, minval=-bound, maxval=bound)
+
+
+def normal_init(key: jax.Array, shape: Tuple[int, ...], std: float = 0.02,
+                dtype=jnp.float32) -> jax.Array:
+    return std * jax.random.normal(key, shape, dtype)
+
+
+# ---- RMSNorm ----------------------------------------------------------------
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm with fp32 internal math (parity: attention_utils.py:247-271)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    variance = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    x32 = x32 * jax.lax.rsqrt(variance + eps)
+    return (x32 * weight.astype(jnp.float32)).astype(dtype)
+
+
+# ---- RoPE -------------------------------------------------------------------
+def get_cos_sin(
+    seq_len: int,
+    head_dim: int,
+    rope_theta: float = 10000.0,
+    dtype=jnp.float32,
+    positions: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute rotary cos/sin tables ``[seq, head_dim]``.
+
+    Matches the HF/reference convention (attention_utils.py:170-210): inverse
+    frequencies over even dims, angles duplicated across the two halves.
+    ``positions`` overrides 0..seq_len-1 (used by CP to slice this rank's
+    sequence shard, reference context_parallel.py:427-473).
+    """
+    inv_freq = 1.0 / (
+        rope_theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    if positions is None:
+        positions = jnp.arange(seq_len, dtype=jnp.float32)
+    else:
+        positions = positions.astype(jnp.float32)
+    freqs = jnp.outer(positions, inv_freq)  # [S, Dh/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, Dh]
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def rotate_half(x: jax.Array) -> jax.Array:
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(
+    q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Apply RoPE. q/k: [B, H, S, Dh]; cos/sin: [S, Dh] (broadcast over B, H)."""
+    cos = cos[None, None, :, :].astype(q.dtype)
+    sin = sin[None, None, :, :].astype(q.dtype)
+    q_rot = q * cos + rotate_half(q) * sin
+    k_rot = k * cos + rotate_half(k) * sin
+    return q_rot, k_rot
+
+
+# ---- attention --------------------------------------------------------------
+def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
+    """GQA KV head expansion [B, Hkv, S, D] -> [B, Hkv*n_rep, S, D].
+
+    The reference uses a zero-copy ``expand`` (llama.py:176-192); under XLA
+    the broadcast is fused away, so an explicit broadcast is equally free.
+    """
+    if n_rep == 1:
+        return k
+    b, h_kv, s, d = k.shape
+    k = jnp.broadcast_to(k[:, :, None, :, :], (b, h_kv, n_rep, s, d))
+    return k.reshape(b, h_kv * n_rep, s, d)
+
+
+def sdpa_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    bias: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Plain XLA scaled-dot-product attention with fp32 softmax.
+
+    q: [B, Hq, S, D]; k/v: [B, Hkv, Skv, D] (GQA expanded here).
+    The default/portable backend (reference 'sdpa', attention_utils.py:130-152).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n_rep = q.shape[1] // k.shape[1]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def sdpa_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """SDPA that also returns the log-sum-exp ``[B, H, S]`` (fp32).
+
+    Building block for ring attention's blockwise LSE merge (reference
+    ring_attention_forward, context_parallel.py:266-330).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n_rep = q.shape[1] // k.shape[1]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), dtype=bool), k=s_k - s_q)
+        scores = jnp.where(mask, scores, -jnp.inf)
+    lse = jax.nn.logsumexp(scores, axis=-1)  # [B, H, S]
+    # Rows with no visible keys (fully masked) have lse = -inf; their output
+    # is defined as 0 so the ring merge can rescale them safely.
+    probs = jnp.exp(scores - jnp.where(jnp.isfinite(lse), lse, 0.0)[..., None])
+    probs = jnp.where(jnp.isfinite(lse)[..., None], probs, 0.0).astype(q.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return out, lse
+
+
+# ---- losses -----------------------------------------------------------------
+def cross_entropy_loss(
+    logits: jax.Array,
+    targets: jax.Array,
+    ignore_index: int = -100,
+) -> jax.Array:
+    """Token-mean cross entropy with ignore_index masking (fp32 internally).
+
+    logits: [..., V]; targets: [...] int32. Matches the reference's
+    F.cross_entropy(ignore_index=-100) semantics (train_step.py:98-103).
+    """
+    logits = logits.astype(jnp.float32)
+    mask = targets != ignore_index
+    safe_targets = jnp.where(mask, targets, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_targets[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * mask
+    denom = jnp.maximum(mask.sum(), 1)
+    return nll.sum() / denom

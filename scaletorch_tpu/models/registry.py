@@ -1,0 +1,56 @@
+"""Attention backend registry.
+
+Parity with reference scaletorch/models/attention_utils.py:33-64:
+``register_attention_backend``/``get_attention_backend`` plus the same
+resolution order — context parallel forces ``ring``, the FLASH_ATTEN env
+toggle selects ``flash``, otherwise ``sdpa`` (attention_utils.py:56-64).
+
+A backend is a callable ``fn(q, k, v, *, causal, scale, **kw) -> out`` with
+q/k/v shaped ``[batch, heads, seq, head_dim]`` (kv heads may differ from q
+heads; backends handle GQA expansion themselves or expect pre-expanded kv).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from scaletorch_tpu.env import get_env
+
+_BACKENDS: Dict[str, Callable] = {}
+
+
+def register_attention_backend(name: str, fn: Callable = None):
+    """Register an attention implementation. Usable as a decorator."""
+
+    def _register(f: Callable) -> Callable:
+        _BACKENDS[name] = f
+        return f
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+def get_attention_backend(name: str) -> Callable:
+    if name not in _BACKENDS:
+        raise KeyError(
+            f"attention backend {name!r} not registered; have {sorted(_BACKENDS)}"
+        )
+    return _BACKENDS[name]
+
+
+def resolve_attention_backend(
+    requested: str = "auto", context_parallel: bool = False
+) -> str:
+    """Resolution order parity: CP -> ring, FLASH_ATTEN -> flash, else sdpa."""
+    if requested != "auto":
+        return requested
+    if context_parallel or get_env("CONTEXT_PARALLEL"):
+        return "ring"
+    if get_env("FLASH_ATTEN") and not get_env("SCALETORCH_TPU_DISABLE_PALLAS"):
+        return "flash"
+    return "sdpa"
+
+
+def registered_backends() -> list[str]:
+    return sorted(_BACKENDS)

@@ -1,0 +1,8 @@
+"""Compute kernels: flash attention (Pallas), ring attention, grouped matmul.
+
+Importing this package registers the 'flash' and (once built) 'ring'
+attention backends, mirroring the reference registering its backends at
+model import (reference models/llama.py:38-57).
+"""
+
+from scaletorch_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,52 @@
+"""Flash attention backend.
+
+The role the reference fills with flash-attn 2 / Ascend's
+``npu_flash_attn_func`` (reference models/attention_utils.py:72-122) is on
+TPU a Pallas blockwise-softmax kernel. Until the custom kernel lands
+(ops/pallas/flash.py), this module provides the dispatch surface and an
+XLA fallback: XLA already fuses QK^T -> softmax -> PV reasonably well on
+TPU, so the fallback is correct and fast-ish; the Pallas kernel removes
+the O(S^2) score materialisation in HBM.
+
+Selection: 'flash' backend -> pallas kernel on TPU unless
+SCALETORCH_TPU_DISABLE_PALLAS=1 or the platform is CPU (tests), in which
+case the XLA fallback runs.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from scaletorch_tpu.env import get_env
+from scaletorch_tpu.models.layers import sdpa_attention
+from scaletorch_tpu.models.registry import register_attention_backend
+
+
+def _pallas_available() -> bool:
+    if get_env("SCALETORCH_TPU_DISABLE_PALLAS"):
+        return False
+    return jax.devices()[0].platform == "tpu"
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """[B, Hq, S, D] x [B, Hkv, S, D]^2 -> [B, Hq, S, D]."""
+    if _pallas_available():
+        try:
+            from scaletorch_tpu.ops.pallas.flash import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
+        except ImportError:
+            pass  # kernel not built yet; fall through to XLA
+    return sdpa_attention(q, k, v, causal=causal, scale=scale)
+
+
+register_attention_backend("flash", flash_attention)

@@ -88,18 +88,27 @@ class MeshManager:
                 f"mesh dims dp*pp*cp*ep*tp = {self.dp}*{self.pp}*{self.cp}*"
                 f"{self.ep}*{self.tp} = {world} != device count {len(self._devices)}"
             )
+        # Axis type Auto = GSPMD sharding propagation decides unannotated
+        # intermediates (jax 0.9 defaults to Explicit, which demands
+        # per-op out_shardings — the wrong default for a framework whose
+        # manual-collective paths live inside shard_map anyway).
+        axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
         if devices is None:
             # Let JAX pick an ICI-friendly assignment of logical mesh axes to
             # the physical torus (this may reorder devices relative to
             # jax.devices() enumeration — see module docstring).
-            self._mesh = jax.make_mesh(self.shape, MESH_AXES)
+            self._mesh = jax.make_mesh(self.shape, MESH_AXES, axis_types)
         else:
             # Explicit device list: caller controls placement; honour their
             # order exactly (used by tests and multi-process setups that
             # pre-arrange devices).
             import numpy as np
 
-            self._mesh = Mesh(np.asarray(self._devices).reshape(self.shape), MESH_AXES)
+            self._mesh = Mesh(
+                np.asarray(self._devices).reshape(self.shape),
+                MESH_AXES,
+                axis_types=axis_types,
+            )
 
     # ---- sizes --------------------------------------------------------------
     @property

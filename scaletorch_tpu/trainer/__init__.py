@@ -1,0 +1,8 @@
+"""Training orchestration: optimizers, schedulers, train step, metrics."""
+
+from scaletorch_tpu.trainer.lr_scheduler import (  # noqa: F401
+    create_lr_scheduler,
+    register_scheduler,
+)
+from scaletorch_tpu.trainer.optimizer import create_optimizer  # noqa: F401
+from scaletorch_tpu.trainer.train_step import make_train_step  # noqa: F401

@@ -1,0 +1,109 @@
+"""LR schedule registry + factory.
+
+Parity with reference scaletorch/trainer/lr_scheduler.py:27-211: a
+``register_scheduler`` registry and a factory covering
+linear / cosine / polynomial / step / onecycle (+ constant), every
+schedule wrapped with linear warmup. Schedules are optax-style pure
+functions ``step -> lr`` so they compose with any optax optimizer and can
+be evaluated inside jit.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import optax
+
+_SCHEDULERS: Dict[str, Callable] = {}
+
+
+def register_scheduler(name: str, fn: Callable = None):
+    """Register ``builder(cfg) -> optax.Schedule``. Usable as decorator."""
+
+    def _register(f):
+        _SCHEDULERS[name] = f
+        return f
+
+    if fn is not None:
+        return _register(fn)
+    return _register
+
+
+def _warmup_steps(cfg) -> int:
+    if cfg.warmup_steps:
+        return cfg.warmup_steps
+    return int(cfg.warmup_ratio * cfg.total_train_steps)
+
+
+def _with_warmup(cfg, schedule: optax.Schedule) -> optax.Schedule:
+    w = _warmup_steps(cfg)
+    if w <= 0:
+        return schedule
+    warmup = optax.linear_schedule(0.0, cfg.learning_rate, w)
+    return optax.join_schedules([warmup, schedule], [w])
+
+
+@register_scheduler("constant")
+def _constant(cfg):
+    return _with_warmup(cfg, optax.constant_schedule(cfg.learning_rate))
+
+
+@register_scheduler("linear")
+def _linear(cfg):
+    decay = max(cfg.total_train_steps - _warmup_steps(cfg), 1)
+    end = cfg.learning_rate * cfg.min_lr_ratio
+    return _with_warmup(cfg, optax.linear_schedule(cfg.learning_rate, end, decay))
+
+
+@register_scheduler("cosine")
+def _cosine(cfg):
+    decay = max(cfg.total_train_steps - _warmup_steps(cfg), 1)
+    return _with_warmup(
+        cfg,
+        optax.cosine_decay_schedule(cfg.learning_rate, decay, alpha=cfg.min_lr_ratio),
+    )
+
+
+@register_scheduler("polynomial")
+def _polynomial(cfg):
+    decay = max(cfg.total_train_steps - _warmup_steps(cfg), 1)
+    return _with_warmup(
+        cfg,
+        optax.polynomial_schedule(
+            init_value=cfg.learning_rate,
+            end_value=cfg.learning_rate * cfg.min_lr_ratio,
+            power=cfg.poly_power,
+            transition_steps=decay,
+        ),
+    )
+
+
+@register_scheduler("step")
+def _step(cfg):
+    return _with_warmup(
+        cfg,
+        optax.exponential_decay(
+            cfg.learning_rate,
+            transition_steps=cfg.step_size,
+            decay_rate=cfg.step_gamma,
+            staircase=True,
+        ),
+    )
+
+
+@register_scheduler("onecycle")
+def _onecycle(cfg):
+    # onecycle defines its own ramp; no extra warmup wrapper.
+    return optax.cosine_onecycle_schedule(
+        transition_steps=max(cfg.total_train_steps, 1),
+        peak_value=cfg.learning_rate,
+    )
+
+
+def create_lr_scheduler(cfg) -> optax.Schedule:
+    """cfg needs: lr_scheduler_type, learning_rate, total_train_steps,
+    warmup_steps/warmup_ratio, min_lr_ratio (+ per-type knobs)."""
+    name = cfg.lr_scheduler_type
+    if name not in _SCHEDULERS:
+        raise KeyError(f"unknown lr scheduler {name!r}; have {sorted(_SCHEDULERS)}")
+    return _SCHEDULERS[name](cfg)

@@ -1,0 +1,111 @@
+"""Per-step training metrics: tokens/s, MFU, memory — console + history.
+
+Parity with reference scaletorch/trainer/metrics.py:23-114
+(log_training_metrics): one line per logging step on the designated
+process with loss / LR / grad-norm / tokens-per-second (global and
+per-chip) / MFU / device memory. MFU uses the same analytic formula as
+the reference (utils/misc.get_mfu) against the TPU FLOPS registry.
+
+Async-dispatch aware: on non-logging steps nothing is materialised — no
+``float(loss)`` host sync, no memory-stats poll — so the host keeps
+dispatching ahead of the device (JAX's async dispatch is the TPU
+equivalent of the reference's non-blocking CUDA stream timing). Rates are
+computed over the window since the previous logged step.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+
+from scaletorch_tpu.utils.device import device_memory_stats, get_theoretical_flops
+from scaletorch_tpu.utils.logger import get_logger
+from scaletorch_tpu.utils.misc import get_mfu, to_readable_format
+
+
+@dataclass
+class MetricsLogger:
+    num_params: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    seq_len: int
+    tokens_per_step: int           # global tokens consumed per optimizer step
+    num_chips: int = 1
+    log_frequency: int = 1
+    peak_flops: Optional[float] = None
+    history: list = field(default_factory=list)
+    _window_start_time: Optional[float] = None
+    _window_start_step: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if self.peak_flops is None:
+            self.peak_flops = get_theoretical_flops()
+
+    def log_step(self, step: int, loss, lr: float, grad_norm) -> dict:
+        """Call every step; materialises/logs only on logging steps.
+
+        ``loss``/``grad_norm`` may be device scalars — they are converted
+        (forcing a host sync) only when this step actually logs.
+        """
+        if step % self.log_frequency != 0:
+            return {}
+
+        now = time.perf_counter()
+        record = {
+            "step": step,
+            "loss": float(loss),
+            "lr": float(lr),
+            "grad_norm": float(grad_norm),
+        }
+        if self._window_start_time is not None:
+            elapsed = now - self._window_start_time
+            steps_in_window = step - self._window_start_step
+            if elapsed > 0 and steps_in_window > 0:
+                tok_s = self.tokens_per_step * steps_in_window / elapsed
+                record.update(
+                    step_time=elapsed / steps_in_window,
+                    tokens_per_second=tok_s,
+                    tokens_per_second_per_chip=tok_s / self.num_chips,
+                    mfu=get_mfu(
+                        tok_s,
+                        self.num_params,
+                        self.num_layers,
+                        self.num_heads,
+                        self.head_dim,
+                        self.seq_len,
+                        num_chips=self.num_chips,
+                        peak_flops=self.peak_flops,
+                    ),
+                )
+        # restart the window *after* materialisation so the sync cost isn't
+        # attributed to the next window
+        self._window_start_time = time.perf_counter()
+        self._window_start_step = step
+
+        mem = device_memory_stats()
+        if mem["bytes_in_use"]:
+            record["memory_gb"] = mem["bytes_in_use"] / 1e9
+            record["peak_memory_gb"] = mem["peak_bytes_in_use"] / 1e9
+        self.history.append(record)
+
+        if jax.process_index() == 0:
+            parts = [
+                f"step {step:>6}",
+                f"loss {record['loss']:.4f}",
+                f"lr {record['lr']:.2e}",
+                f"gnorm {record['grad_norm']:.3f}",
+            ]
+            if "tokens_per_second" in record:
+                parts += [
+                    f"tok/s {to_readable_format(record['tokens_per_second'])}",
+                    f"tok/s/chip {to_readable_format(record['tokens_per_second_per_chip'])}",
+                    f"MFU {record['mfu']:.1f}%",
+                ]
+            if "memory_gb" in record:
+                parts.append(f"mem {record['memory_gb']:.1f}GB")
+            get_logger().info(" | ".join(parts))
+        return record

@@ -1,0 +1,59 @@
+"""Optimizer factory over optax.
+
+Parity with reference create_optimizer (scaletorch/trainer/model_builder.py:
+103-162): adamw (the production default; 'fused' on NPU/CUDA maps to XLA's
+already-fused optax update on TPU), adam, sgd, lamb — plus adafactor as the
+TPU-native memory-lean extra. Gradient clipping is part of the chain
+(clip-by-global-norm before the update, reference train_step.py:122-136);
+the pre-clip grad norm is reported separately by the train step.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import optax
+
+
+def create_optimizer(
+    cfg, schedule: Optional[optax.Schedule] = None
+) -> Tuple[optax.GradientTransformation, optax.Schedule]:
+    """cfg needs: optimizer_name, learning_rate, weight_decay, adam_beta1/2,
+    adam_epsilon, max_grad_norm, momentum (+ scheduler fields if schedule
+    is None)."""
+    if schedule is None:
+        from scaletorch_tpu.trainer.lr_scheduler import create_lr_scheduler
+
+        schedule = create_lr_scheduler(cfg)
+
+    name = cfg.optimizer_name.lower()
+    if name == "adamw":
+        tx = optax.adamw(
+            schedule,
+            b1=cfg.adam_beta1,
+            b2=cfg.adam_beta2,
+            eps=cfg.adam_epsilon,
+            weight_decay=cfg.weight_decay,
+        )
+    elif name == "adam":
+        tx = optax.adam(
+            schedule, b1=cfg.adam_beta1, b2=cfg.adam_beta2, eps=cfg.adam_epsilon
+        )
+    elif name == "sgd":
+        tx = optax.sgd(schedule, momentum=cfg.momentum)
+    elif name == "lamb":
+        tx = optax.lamb(
+            schedule,
+            b1=cfg.adam_beta1,
+            b2=cfg.adam_beta2,
+            eps=cfg.adam_epsilon,
+            weight_decay=cfg.weight_decay,
+        )
+    elif name == "adafactor":
+        tx = optax.adafactor(schedule)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.optimizer_name!r}")
+
+    if getattr(cfg, "max_grad_norm", 0) and cfg.max_grad_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm), tx)
+    return tx, schedule

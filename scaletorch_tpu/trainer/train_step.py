@@ -1,0 +1,127 @@
+"""The jitted training step: grad accumulation, clipping, update, metrics.
+
+Parity with reference scaletorch/trainer/train_step.py:14-136 (non-PP
+path): per-microbatch forward/backward under grad accumulation with a
+single gradient synchronisation (the ``no_sync`` contract,
+data_parallel.py:46-68), loss scaled by 1/accum, clip-by-global-norm, then
+the optimizer step.
+
+TPU-native shape: the whole optimizer step is ONE jitted function; grad
+accumulation is a ``lax.scan`` over the leading microbatch axis, so
+activation memory stays at one microbatch while XLA fuses the accumulation
+adds. Buffers are donated (params/opt_state update in place in HBM).
+Under a data-sharded mesh, gradients are psum'd by XLA as part of the
+backward; the scan keeps accumulation local so the reduction cost is paid
+once per step, matching the reference's bucketed-overlap design intent
+(bucketing itself is subsumed by XLA fusion — SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from scaletorch_tpu.models.layers import cross_entropy_loss
+
+Batch = Dict[str, jax.Array]  # input_ids/target_ids: [accum, micro_bs, seq]
+
+
+def make_loss_fn(forward: Callable, cfg, *, attention_backend: str,
+                 gradient_checkpointing: bool) -> Callable:
+    """loss(params, microbatch) -> scalar fp32."""
+
+    def loss_fn(params, mb: Batch) -> jax.Array:
+        logits = forward(
+            params,
+            mb["input_ids"],
+            cfg,
+            positions=mb.get("position_ids"),
+            attention_backend=attention_backend,
+            gradient_checkpointing=gradient_checkpointing,
+        )
+        return cross_entropy_loss(logits, mb["target_ids"])
+
+    return loss_fn
+
+
+def accumulate_gradients(
+    loss_fn: Callable, params: Any, batch: Batch
+) -> Tuple[jax.Array, Any]:
+    """Mean loss + mean grads over the leading accumulation axis via scan."""
+    accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
+
+    def micro_step(carry, mb):
+        grads_acc, loss_acc = carry
+        loss, grads = jax.value_and_grad(loss_fn)(params, mb)
+        grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
+        return (grads_acc, loss_acc + loss), None
+
+    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+    (grads, loss_sum), _ = jax.lax.scan(micro_step, (zeros, jnp.float32(0.0)), batch)
+    scale = 1.0 / accum
+    grads = jax.tree.map(lambda g: g * scale, grads)
+    return loss_sum * scale, grads
+
+
+def make_train_step(
+    forward: Callable,
+    cfg,
+    optimizer: optax.GradientTransformation,
+    *,
+    attention_backend: str = "sdpa",
+    gradient_checkpointing: bool = False,
+    donate: bool = True,
+    mesh=None,
+    data_spec=None,
+) -> Callable:
+    """Build the jitted step: (params, opt_state, batch) ->
+    (params, opt_state, metrics).
+
+    ``mesh``/``data_spec`` optionally pin GSPMD shardings: batch leaves get
+    ``data_spec`` (e.g. P(None, 'dp', None)), params/opt-state shardings are
+    taken from their current placement.
+    """
+    loss_fn = make_loss_fn(
+        forward,
+        cfg,
+        attention_backend=attention_backend,
+        gradient_checkpointing=gradient_checkpointing,
+    )
+
+    def train_step(params, opt_state, batch):
+        loss, grads = accumulate_gradients(loss_fn, params, batch)
+        grad_norm = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {"loss": loss, "grad_norm": grad_norm}
+        return params, opt_state, metrics
+
+    donate_argnums = (0, 1) if donate else ()
+    if mesh is not None and data_spec is not None:
+        from jax.sharding import NamedSharding
+
+        batch_sharding = NamedSharding(mesh, data_spec)
+        return jax.jit(
+            train_step,
+            donate_argnums=donate_argnums,
+            in_shardings=(None, None, batch_sharding),
+        )
+    return jax.jit(train_step, donate_argnums=donate_argnums)
+
+
+def make_eval_step(forward: Callable, cfg, *, attention_backend: str = "sdpa"):
+    loss_fn = make_loss_fn(
+        forward, cfg, attention_backend=attention_backend,
+        gradient_checkpointing=False,
+    )
+
+    @jax.jit
+    def eval_step(params, batch):
+        # batch: [micro_bs, seq] (no accumulation axis)
+        return loss_fn(params, batch)
+
+    return eval_step

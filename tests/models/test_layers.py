@@ -1,0 +1,150 @@
+"""Building-block numerics: RMSNorm, RoPE, SDPA, losses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaletorch_tpu.models.layers import (
+    apply_rotary_pos_emb,
+    cross_entropy_loss,
+    get_cos_sin,
+    repeat_kv,
+    rms_norm,
+    sdpa_attention,
+    sdpa_attention_with_lse,
+)
+
+
+class TestRmsNorm:
+    def test_matches_manual(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 5, 8))
+        w = jnp.linspace(0.5, 1.5, 8)
+        out = rms_norm(x, w, eps=1e-6)
+        expected = x / np.sqrt((np.asarray(x) ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(out, expected, rtol=1e-5)
+
+    def test_preserves_dtype_fp32_internal(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (2, 8)).astype(jnp.bfloat16)
+        out = rms_norm(x, jnp.ones(8))
+        assert out.dtype == jnp.bfloat16
+
+
+class TestRope:
+    def test_rotation_preserves_norm(self):
+        q = jax.random.normal(jax.random.PRNGKey(1), (1, 2, 16, 8))
+        cos, sin = get_cos_sin(16, 8)
+        q_rot, _ = apply_rotary_pos_emb(q, q, cos, sin)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(q_rot), axis=-1),
+            np.linalg.norm(np.asarray(q), axis=-1),
+            rtol=1e-5,
+        )
+
+    def test_relative_position_property(self):
+        """<rope(q,m), rope(k,n)> depends only on m-n."""
+        d = 8
+        q = jax.random.normal(jax.random.PRNGKey(2), (1, 1, 1, d))
+        k = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, d))
+
+        def dot_at(m, n):
+            cos_m, sin_m = get_cos_sin(1, d, positions=jnp.array([m]))
+            cos_n, sin_n = get_cos_sin(1, d, positions=jnp.array([n]))
+            qm, _ = apply_rotary_pos_emb(q, q, cos_m, sin_m)
+            kn, _ = apply_rotary_pos_emb(k, k, cos_n, sin_n)
+            return float(jnp.sum(qm * kn))
+
+        assert dot_at(5, 3) == pytest.approx(dot_at(12, 10), rel=1e-4)
+        assert dot_at(5, 3) != pytest.approx(dot_at(5, 4), rel=1e-2)
+
+    def test_positions_override_slices_table(self):
+        """CP parity: rank-local positions give rows of the global table
+        (reference update_rope_for_context_parallel)."""
+        cos_full, sin_full = get_cos_sin(16, 8)
+        cos_shard, sin_shard = get_cos_sin(
+            8, 8, positions=jnp.arange(8, 16)
+        )
+        np.testing.assert_allclose(cos_shard, cos_full[8:], rtol=1e-6)
+        np.testing.assert_allclose(sin_shard, sin_full[8:], rtol=1e-6)
+
+
+class TestRepeatKv:
+    def test_expand(self):
+        k = jnp.arange(2 * 2 * 3 * 4.0).reshape(2, 2, 3, 4)
+        out = repeat_kv(k, 3)
+        assert out.shape == (2, 6, 3, 4)
+        np.testing.assert_array_equal(out[:, 0], out[:, 1])
+        np.testing.assert_array_equal(out[:, 0], k[:, 0])
+        np.testing.assert_array_equal(out[:, 3], k[:, 1])
+
+    def test_noop(self):
+        k = jnp.ones((1, 2, 3, 4))
+        assert repeat_kv(k, 1) is k
+
+
+class TestSdpa:
+    def test_causal_masking(self):
+        """Output at position i must not depend on keys > i."""
+        key = jax.random.PRNGKey(4)
+        q, k, v = (jax.random.normal(kk, (1, 2, 6, 8)) for kk in jax.random.split(key, 3))
+        out1 = sdpa_attention(q, k, v, causal=True)
+        # perturb the last key/value: only the last position may change
+        k2 = k.at[:, :, -1].add(10.0)
+        v2 = v.at[:, :, -1].add(10.0)
+        out2 = sdpa_attention(q, k2, v2, causal=True)
+        np.testing.assert_allclose(out1[:, :, :-1], out2[:, :, :-1], atol=1e-6)
+        assert not np.allclose(out1[:, :, -1], out2[:, :, -1])
+
+    def test_matches_naive_loop(self):
+        key = jax.random.PRNGKey(5)
+        q, k, v = (jax.random.normal(kk, (1, 1, 4, 4)) for kk in jax.random.split(key, 3))
+        out = np.asarray(sdpa_attention(q, k, v, causal=True))[0, 0]
+        qn, kn, vn = np.asarray(q)[0, 0], np.asarray(k)[0, 0], np.asarray(v)[0, 0]
+        for i in range(4):
+            scores = (qn[i] @ kn[: i + 1].T) / np.sqrt(4)
+            p = np.exp(scores - scores.max())
+            p /= p.sum()
+            np.testing.assert_allclose(out[i], p @ vn[: i + 1], rtol=1e-5, atol=1e-6)
+
+    def test_gqa_matches_expanded(self):
+        key = jax.random.PRNGKey(6)
+        q = jax.random.normal(key, (2, 4, 5, 8))
+        k = jax.random.normal(jax.random.fold_in(key, 1), (2, 2, 5, 8))
+        v = jax.random.normal(jax.random.fold_in(key, 2), (2, 2, 5, 8))
+        out = sdpa_attention(q, k, v, causal=True)
+        out_exp = sdpa_attention(q, repeat_kv(k, 2), repeat_kv(v, 2), causal=True)
+        np.testing.assert_allclose(out, out_exp, atol=1e-6)
+
+    def test_lse_variant_consistent(self):
+        key = jax.random.PRNGKey(7)
+        q, k, v = (jax.random.normal(kk, (1, 2, 6, 8)) for kk in jax.random.split(key, 3))
+        out_ref = sdpa_attention(q, k, v, causal=True)
+        out, lse = sdpa_attention_with_lse(q, k, v, causal=True)
+        np.testing.assert_allclose(out, out_ref, atol=1e-5)
+        assert lse.shape == (1, 2, 6)
+        assert lse.dtype == jnp.float32
+
+
+class TestCrossEntropy:
+    def test_matches_manual(self):
+        logits = jax.random.normal(jax.random.PRNGKey(8), (2, 3, 5))
+        targets = jnp.array([[0, 1, 2], [3, 4, 0]])
+        loss = cross_entropy_loss(logits, targets)
+        logp = jax.nn.log_softmax(np.asarray(logits, dtype=np.float32), axis=-1)
+        expected = -np.take_along_axis(
+            np.asarray(logp), np.asarray(targets)[..., None], axis=-1
+        ).mean()
+        assert float(loss) == pytest.approx(float(expected), rel=1e-5)
+
+    def test_ignore_index(self):
+        logits = jax.random.normal(jax.random.PRNGKey(9), (1, 4, 5))
+        t_full = jnp.array([[1, 2, 3, 4]])
+        t_masked = jnp.array([[1, 2, -100, -100]])
+        l_masked = cross_entropy_loss(logits, t_masked)
+        l_first_two = cross_entropy_loss(logits[:, :2], t_full[:, :2])
+        assert float(l_masked) == pytest.approx(float(l_first_two), rel=1e-5)
+
+    def test_all_ignored_is_finite(self):
+        logits = jnp.ones((1, 2, 5))
+        loss = cross_entropy_loss(logits, jnp.full((1, 2), -100))
+        assert float(loss) == 0.0

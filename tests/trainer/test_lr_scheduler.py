@@ -1,0 +1,63 @@
+"""LR schedule math (parity: reference tests of lr_scheduler registry)."""
+
+import pytest
+
+from scaletorch_tpu.config import ScaleTorchTPUArguments
+from scaletorch_tpu.trainer.lr_scheduler import create_lr_scheduler, register_scheduler
+
+
+def args(**kw):
+    return ScaleTorchTPUArguments(
+        total_train_steps=100, learning_rate=1e-2, **kw
+    )
+
+
+class TestSchedules:
+    def test_cosine_warmup_and_floor(self):
+        s = create_lr_scheduler(args(lr_scheduler_type="cosine", warmup_steps=10,
+                                     min_lr_ratio=0.1))
+        assert float(s(0)) == pytest.approx(0.0)
+        assert float(s(5)) == pytest.approx(0.5e-2, rel=1e-6)
+        assert float(s(10)) == pytest.approx(1e-2, rel=1e-6)
+        assert float(s(100)) == pytest.approx(1e-3, rel=1e-4)
+
+    def test_warmup_ratio(self):
+        s = create_lr_scheduler(args(lr_scheduler_type="constant", warmup_ratio=0.2))
+        assert float(s(10)) == pytest.approx(0.5e-2, rel=1e-6)
+        assert float(s(20)) == pytest.approx(1e-2, rel=1e-6)
+        assert float(s(99)) == pytest.approx(1e-2, rel=1e-6)
+
+    def test_linear_decay(self):
+        s = create_lr_scheduler(args(lr_scheduler_type="linear", min_lr_ratio=0.0))
+        assert float(s(0)) == pytest.approx(1e-2, rel=1e-6)
+        assert float(s(50)) == pytest.approx(0.5e-2, rel=1e-4)
+        assert float(s(100)) == pytest.approx(0.0, abs=1e-8)
+
+    def test_step_decay(self):
+        s = create_lr_scheduler(args(lr_scheduler_type="step", step_size=10,
+                                     step_gamma=0.5))
+        assert float(s(9)) == pytest.approx(1e-2, rel=1e-6)
+        assert float(s(10)) == pytest.approx(0.5e-2, rel=1e-6)
+        assert float(s(20)) == pytest.approx(0.25e-2, rel=1e-6)
+
+    def test_onecycle_peak(self):
+        s = create_lr_scheduler(args(lr_scheduler_type="onecycle"))
+        peak = max(float(s(i)) for i in range(100))
+        assert peak == pytest.approx(1e-2, rel=1e-3)
+
+    def test_polynomial(self):
+        s = create_lr_scheduler(args(lr_scheduler_type="polynomial",
+                                     min_lr_ratio=0.0, poly_power=1.0))
+        assert float(s(50)) == pytest.approx(0.5e-2, rel=1e-4)
+
+    def test_unknown_raises(self):
+        with pytest.raises(KeyError, match="unknown lr scheduler"):
+            create_lr_scheduler(args(lr_scheduler_type="nope"))
+
+    def test_register_custom(self):
+        @register_scheduler("fixed42")
+        def _fixed(cfg):
+            return lambda step: 42.0
+
+        s = create_lr_scheduler(args(lr_scheduler_type="fixed42"))
+        assert s(7) == 42.0

@@ -1,0 +1,123 @@
+"""Train-step semantics: grad accumulation, clipping, optimization."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaletorch_tpu.config import ScaleTorchTPUArguments
+from scaletorch_tpu.models.llama import LlamaConfig, forward, init_params
+from scaletorch_tpu.trainer.optimizer import create_optimizer
+from scaletorch_tpu.trainer.train_step import (
+    accumulate_gradients,
+    make_loss_fn,
+    make_train_step,
+)
+
+CFG = LlamaConfig(
+    vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+    num_attention_heads=4, num_key_value_heads=2, dtype=jnp.float32,
+)
+
+
+def make_batch(accum, bs, seq=16, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, CFG.vocab_size, size=(accum, bs, seq + 1), dtype=np.int32)
+    return {
+        "input_ids": jnp.asarray(toks[:, :, :-1]),
+        "target_ids": jnp.asarray(toks[:, :, 1:]),
+        "position_ids": jnp.broadcast_to(jnp.arange(seq, dtype=jnp.int32), (accum, seq)),
+    }
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.PRNGKey(0), CFG)
+
+
+class TestGradAccumulation:
+    def test_accum_equals_big_batch(self, params):
+        """no_sync contract: accumulating 4 microbatches of 1 == one
+        microbatch of 4 (loss is a token mean; equal-size microbatches)."""
+        loss_fn = make_loss_fn(forward, CFG, attention_backend="sdpa",
+                               gradient_checkpointing=False)
+        toks = make_batch(4, 1)
+        big = {
+            "input_ids": toks["input_ids"].reshape(1, 4, 16),
+            "target_ids": toks["target_ids"].reshape(1, 4, 16),
+            "position_ids": toks["position_ids"][:1],
+        }
+        loss_a, grads_a = accumulate_gradients(loss_fn, params, toks)
+        loss_b, grads_b = accumulate_gradients(loss_fn, params, big)
+        assert float(loss_a) == pytest.approx(float(loss_b), rel=1e-5)
+        for a, b in zip(jax.tree.leaves(grads_a), jax.tree.leaves(grads_b)):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_grads_are_fp32(self, params):
+        loss_fn = make_loss_fn(forward, CFG, attention_backend="sdpa",
+                               gradient_checkpointing=False)
+        _, grads = accumulate_gradients(loss_fn, params, make_batch(2, 1))
+        for g in jax.tree.leaves(grads):
+            assert g.dtype == jnp.float32
+
+
+class TestTrainStep:
+    def test_memorizes_fixed_batch(self, params):
+        args = ScaleTorchTPUArguments(total_train_steps=40, learning_rate=3e-3)
+        tx, _ = create_optimizer(args)
+        opt_state = tx.init(params)
+        step = make_train_step(forward, CFG, tx, donate=False)
+        batch = make_batch(1, 2)
+        p = params
+        first = None
+        for i in range(30):
+            p, opt_state, m = step(p, opt_state, batch)
+            if first is None:
+                first = float(m["loss"])
+        assert float(m["loss"]) < 0.5 * first
+
+    def test_metrics_contract(self, params):
+        args = ScaleTorchTPUArguments(total_train_steps=10)
+        tx, _ = create_optimizer(args)
+        step = make_train_step(forward, CFG, tx, donate=False)
+        _, _, m = step(params, tx.init(params), make_batch(2, 1))
+        assert set(m) == {"loss", "grad_norm"}
+        assert float(m["grad_norm"]) > 0
+
+    def test_grad_clipping_bounds_update(self, params):
+        """With max_grad_norm tiny, the applied update must be bounded."""
+        args = ScaleTorchTPUArguments(
+            total_train_steps=10, learning_rate=1.0, max_grad_norm=1e-6,
+            optimizer_name="sgd", warmup_steps=0,
+        )
+        tx, _ = create_optimizer(args)
+        step = make_train_step(forward, CFG, tx, donate=False)
+        p2, _, _ = step(params, tx.init(params), make_batch(1, 1))
+        diffs = [
+            float(jnp.abs(a - b).max())
+            for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2))
+        ]
+        assert max(diffs) < 1e-5
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("name", ["adamw", "adam", "sgd", "lamb", "adafactor"])
+    def test_all_optimizers_step(self, params, name):
+        args = ScaleTorchTPUArguments(
+            total_train_steps=10, optimizer_name=name, learning_rate=1e-3
+        )
+        tx, _ = create_optimizer(args)
+        step = make_train_step(forward, CFG, tx, donate=False)
+        p2, _, m = step(params, tx.init(params), make_batch(1, 1))
+        assert np.isfinite(float(m["loss"]))
+        # params changed
+        changed = any(
+            not np.allclose(a, b)
+            for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(p2))
+        )
+        assert changed
+
+    def test_unknown_optimizer(self):
+        args = ScaleTorchTPUArguments(optimizer_name="zeus")
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            create_optimizer(args)

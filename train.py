@@ -1,0 +1,45 @@
+#!/usr/bin/env python
+"""Training entry point.
+
+TPU-native counterpart of reference train.py:55-453: parse composed
+dataclass args, set up the device mesh, build model/optimizer/data, run
+the training loop with metrics + checkpointing.
+
+Examples:
+  # single chip, synthetic data
+  python train.py --model_type llama --hidden_size 512 --num_hidden_layers 8 \
+      --synthetic_data true --total_train_steps 20
+
+  # 8 virtual CPU devices, DP8 (tests/multi-chip dry runs)
+  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python train.py --data_parallel_size 8 --synthetic_data true ...
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    from scaletorch_tpu.config import parse_args
+    from scaletorch_tpu.trainer.trainer import Trainer
+    from scaletorch_tpu.utils.logger import get_logger
+
+    cfg = parse_args(argv)
+    trainer = Trainer(cfg)
+    if cfg.resume_from_checkpoint and cfg.checkpoint_dir:
+        trainer.load_checkpoint()
+    try:
+        last = trainer.train()
+    except KeyboardInterrupt:
+        get_logger().warning("interrupted; exiting")
+        return 130
+    if cfg.checkpoint_dir and cfg.save_frequency:
+        trainer.save_checkpoint()
+    get_logger().info(f"done: {last}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
