@@ -200,6 +200,13 @@ class ScaleTorchTPUArguments(
                 f"sequence_length {self.sequence_length} not divisible by "
                 f"context_parallel_size {self.context_parallel_size}"
             )
+        if self.sequence_parallel:
+            seq_local = self.sequence_length // self.context_parallel_size
+            if seq_local % self.tensor_parallel_size != 0:
+                raise ValueError(
+                    f"sequence_parallel needs per-cp-rank sequence {seq_local} "
+                    f"divisible by tensor_parallel_size {self.tensor_parallel_size}"
+                )
         expected_gbs = (
             self.data_parallel_size
             * self.micro_batch_size

@@ -154,6 +154,11 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     return params
 
 
+# Re-exported for backwards compatibility; canonical home is
+# parallel/tensor_parallel.py.
+from scaletorch_tpu.parallel.tensor_parallel import pvary_missing  # noqa: E402
+
+
 def _decoder_layer(
     x: jax.Array,
     layer: Params,
@@ -161,35 +166,87 @@ def _decoder_layer(
     sin: jax.Array,
     cfg: LlamaConfig,
     attn_fn: Callable,
+    tp_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
 ) -> jax.Array:
-    """One pre-norm decoder block. x: [B, S, H] in compute dtype."""
-    b, s, _ = x.shape
-    nh, nkv, dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.actual_head_dim
+    """One pre-norm decoder block. x: [B, S, H] in compute dtype.
+
+    With ``tp_axis`` set (inside shard_map, weights arriving pre-sharded
+    per llama_param_specs): q/k/v/gate/up are column-parallel, o/down are
+    row-parallel (reference apply_tensor_parallel mapping,
+    tensor_parallel.py:107-143). With ``sequence_parallel``, x is
+    seq-sharded over tp; norm regions run on the shard, attention/MLP on
+    the gathered sequence, and the row-parallel all-reduce becomes a
+    reduce-scatter (reference llama.py:314-377, sp_comms.py:31-94).
+    """
+    nh_l = layer["q_proj"].shape[-1]  # local q width (already tp-sliced)
+    nkv_l = layer["k_proj"].shape[-1]
+    dh = cfg.actual_head_dim
     cdt = cfg.dtype
+    tp = tp_axis
+
+    if tp:
+        from scaletorch_tpu.parallel.sequence_parallel import all_gather_sequence
+        from scaletorch_tpu.parallel.tensor_parallel import (
+            column_parallel_linear,
+            row_parallel_linear,
+        )
+
+        def pv(t):
+            return pvary_missing(t, tp)
+
+        def enter_full_seq(h):
+            # norm-region shard -> full sequence for attention/MLP
+            return all_gather_sequence(h, tp) if sequence_parallel else pv(h)
+
+        def col(h, w):
+            return column_parallel_linear(h, w.astype(cdt), axis=tp)
+
+        def row(h, w):
+            return row_parallel_linear(
+                h, w.astype(cdt), axis=tp, sequence_parallel=sequence_parallel
+            )
+
+    else:
+
+        def pv(t):
+            return t
+
+        def enter_full_seq(h):
+            return h
+
+        def col(h, w):
+            return h @ w.astype(cdt)
+
+        def row(h, w):
+            return h @ w.astype(cdt)
 
     # ---- attention ----------------------------------------------------------
-    h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
-    q = (h @ layer["q_proj"].astype(cdt)).reshape(b, s, nh, dh)
-    k = (h @ layer["k_proj"].astype(cdt)).reshape(b, s, nkv, dh)
-    v = (h @ layer["v_proj"].astype(cdt)).reshape(b, s, nkv, dh)
+    h = rms_norm(x, pv(layer["input_layernorm"]), cfg.rms_norm_eps)
+    h = enter_full_seq(h)
+    b, s, _ = h.shape
+    q = col(h, layer["q_proj"]).reshape(b, s, nh_l // dh, dh)
+    k = col(h, layer["k_proj"]).reshape(b, s, nkv_l // dh, dh)
+    v = col(h, layer["v_proj"]).reshape(b, s, nkv_l // dh, dh)
     if cfg.qk_norm:
         # Qwen3: RMSNorm over head_dim, per head, before RoPE
         # (reference model_qwen3.py:179-180,209-210).
-        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
-        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
-    q = q.transpose(0, 2, 1, 3)  # [B, H, S, D]
+        q = rms_norm(q, pv(layer["q_norm"]), cfg.rms_norm_eps)
+        k = rms_norm(k, pv(layer["k_norm"]), cfg.rms_norm_eps)
+    q = q.transpose(0, 2, 1, 3)  # [B, H_local, S, D]
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
-    q, k = apply_rotary_pos_emb(q, k, cos, sin)
+    q, k = apply_rotary_pos_emb(q, k, pv(cos), pv(sin))
     attn = attn_fn(q, k, v, causal=True)
-    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh * dh)
-    x = x + attn @ layer["o_proj"].astype(cdt)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh_l)
+    x = x + row(attn, layer["o_proj"])
 
     # ---- SwiGLU MLP (reference llama.py:207-249) ----------------------------
-    h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(h @ layer["gate_proj"].astype(cdt))
-    up = h @ layer["up_proj"].astype(cdt)
-    x = x + (gate * up) @ layer["down_proj"].astype(cdt)
+    h = rms_norm(x, pv(layer["post_attention_layernorm"]), cfg.rms_norm_eps)
+    h = enter_full_seq(h)
+    gate = jax.nn.silu(col(h, layer["gate_proj"]))
+    up = col(h, layer["up_proj"])
+    x = x + row(gate * up, layer["down_proj"])
     return x
 
 
@@ -201,16 +258,43 @@ def forward(
     positions: Optional[jax.Array] = None,
     attention_backend: str = "sdpa",
     gradient_checkpointing: bool = False,
+    tp_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
 ) -> jax.Array:
-    """Full decoder forward: [B, S] int tokens -> [B, S, V] logits.
+    """Full decoder forward: [B, S] int tokens -> logits.
+
+    Pure single-device semantics by default. With ``tp_axis`` (must run
+    inside a shard_map over that mesh axis, params sharded per
+    llama_param_specs) the decoder runs Megatron-style tensor parallel and
+    the returned logits are **vocab-sharded** [B, S, V/tp] — pair with
+    vocab_parallel_cross_entropy, or all-gather for dense logits.
 
     ``positions`` (shape [S]) overrides absolute positions for the RoPE
     table — CP passes this rank's sequence-shard positions (reference
     update_rope_for_context_parallel, context_parallel.py:427-473).
     """
     cdt = cfg.dtype
-    x = params["embed_tokens"][input_ids].astype(cdt)  # [B, S, H]
-    s = x.shape[1]
+    s = input_ids.shape[1]
+
+    if tp_axis is None:
+        x = params["embed_tokens"][input_ids].astype(cdt)  # [B, S, H]
+    else:
+        from scaletorch_tpu.parallel.sequence_parallel import reduce_scatter_sequence
+        from scaletorch_tpu.parallel.tensor_parallel import vocab_parallel_embedding
+
+        if sequence_parallel:
+            # Fused all-reduce + seq-scatter: the embedding's partial sums
+            # are completed by the reduce-scatter that enters the SP region
+            # (reference skips the embedding all-reduce under SP the same
+            # way, tensor_parallel.py:238-240 + llama.py:530-552).
+            partial = vocab_parallel_embedding(
+                input_ids, params["embed_tokens"], axis=tp_axis, reduce="none"
+            )
+            x = reduce_scatter_sequence(partial.astype(cdt), tp_axis)
+        else:
+            x = vocab_parallel_embedding(
+                input_ids, params["embed_tokens"], axis=tp_axis
+            ).astype(cdt)
 
     # RoPE tables computed once and shared across layers (reference
     # llama.py:476-491), fp32 then cast at application.
@@ -220,7 +304,10 @@ def forward(
     attn_fn = get_attention_backend(attention_backend)
 
     def layer_body(h, layer_params):
-        h = _decoder_layer(h, layer_params, cos, sin, cfg, attn_fn)
+        h = _decoder_layer(
+            h, layer_params, cos, sin, cfg, attn_fn,
+            tp_axis=tp_axis, sequence_parallel=sequence_parallel,
+        )
         return h, None
 
     if gradient_checkpointing:
@@ -230,12 +317,23 @@ def forward(
 
     x, _ = jax.lax.scan(layer_body, x, params["layers"])
 
-    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
-    if cfg.tie_word_embeddings:
-        logits = x @ params["embed_tokens"].astype(cdt).T
-    else:
-        logits = x @ params["lm_head"].astype(cdt)
-    return logits
+    x = rms_norm(
+        x,
+        pvary_missing(params["norm"], tp_axis) if tp_axis else params["norm"],
+        cfg.rms_norm_eps,
+    )
+    if sequence_parallel:
+        from scaletorch_tpu.parallel.sequence_parallel import all_gather_sequence
+
+        x = all_gather_sequence(x, tp_axis)
+    head = (
+        params["embed_tokens"].astype(cdt).T
+        if cfg.tie_word_embeddings
+        else params["lm_head"].astype(cdt)
+    )
+    if tp_axis is not None:
+        head = pvary_missing(head, tp_axis)
+    return x @ head
 
 
 class Llama:

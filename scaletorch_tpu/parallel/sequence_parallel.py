@@ -1,0 +1,36 @@
+"""Sequence parallelism (Megatron-style) over the TP axis.
+
+Parity with reference scaletorch/parallel/sequence_parallel/sp_comms.py:
+31-94: ``AllGatherFromSequenceParallelRegion`` (all-gather seq-dim forward
+/ reduce-scatter backward) and ``ReduceScatterToSequenceParallelRegion``
+(reduce-scatter forward / all-gather backward), both on the **TP group**
+with seq dim = 1 (sp_comms.py:10). SP shards the norm/residual regions of
+the decoder along the sequence so their activations and the layernorm
+math are 1/tp-sized; attention/MLP still see the full sequence.
+
+As with tensor_parallel, JAX derives the backward collective from the
+forward one (all_gather^T = psum_scatter and vice versa), so the
+autograd-Function pairs collapse to two one-liners used inside shard_map.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def all_gather_sequence(x: jax.Array, axis: str = "tp", seq_dim: int = 1) -> jax.Array:
+    """Enter a full-sequence region: [B, S/tp, H] -> [B, S, H].
+
+    Forward all-gather; backward reduce-scatter (reference
+    AllGatherFromSequenceParallelRegion, sp_comms.py:31-61).
+    """
+    return jax.lax.all_gather(x, axis, axis=seq_dim, tiled=True)
+
+
+def reduce_scatter_sequence(x: jax.Array, axis: str = "tp", seq_dim: int = 1) -> jax.Array:
+    """Leave a full-sequence region: [B, S, H] (tp-partial) -> [B, S/tp, H].
+
+    Forward reduce-scatter; backward all-gather (reference
+    ReduceScatterToSequenceParallelRegion, sp_comms.py:64-94).
+    """
+    return jax.lax.psum_scatter(x, axis, scatter_dimension=seq_dim, tiled=True)

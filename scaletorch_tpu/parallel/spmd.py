@@ -1,0 +1,211 @@
+"""The unified SPMD training step over the 5D mesh.
+
+This is the load-bearing composition point: one ``shard_map`` over the
+full ``(dp, pp, cp, ep, tp)`` mesh wraps loss, backward, gradient
+reduction, clipping and the optimizer update — the role the reference
+splits across DataParallelBucket hooks, tp autograd functions, and the
+trainer loop (SURVEY.md §3.3):
+
+  * DP/CP: batch (and sequence) sharded; gradients ``pmean``'d over the
+    fused ``(dp, cp)`` group once per step — the reference's bucketed
+    overlapped all-reduce on cp_dp_group (bucket.py:58-77,
+    data_parallel.py:100-128). Accumulation over microbatches stays
+    local (``no_sync`` contract); XLA's latency-hiding scheduler overlaps
+    the reduction with the backward epilogue.
+  * TP/SP: the model runs its tensor-parallel path (models/llama.py) with
+    params arriving pre-sharded per llama_param_specs; the loss is
+    computed vocab-parallel so full logits never materialise.
+  * Gradient clipping uses the *global* norm: tp-sharded leaves contribute
+    their shard's square-sum exactly once via a psum over tp, replicated
+    leaves once with no psum — matching the reference's clip_grad_norm_
+    over the full parameter set (train_step.py:122-136).
+
+PP/EP join this composition in their own modules (pipeline_parallel /
+expert_parallel) — the spmd step accepts a stage-local forward for PP.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from scaletorch_tpu.parallel.mesh import DATA_AXES, MeshManager
+from scaletorch_tpu.parallel.tensor_parallel import (
+    llama_param_specs,
+    vocab_parallel_cross_entropy,
+)
+
+
+def opt_state_specs(tx: optax.GradientTransformation, params: Any, param_specs: Any):
+    """PartitionSpec tree for the optimizer state: params-like leaves (mu,
+    nu, ...) inherit the param's spec, scalars are replicated."""
+    state_shape = jax.eval_shape(tx.init, params)
+    return optax.tree_map_params(
+        tx,
+        lambda _, spec: spec,
+        state_shape,
+        param_specs,
+        transform_non_params=lambda _: P(),
+    )
+
+
+def _leaf_sqsum_partitioned(grads: Any, tp_axis: str) -> jax.Array:
+    """Global sum of squares over a gradient tree whose leaves are a mix of
+    tp-sharded (varying over tp) and replicated (unvarying) arrays."""
+    local_sharded = jnp.float32(0.0)
+    replicated = jnp.float32(0.0)
+    for g in jax.tree_util.tree_leaves(grads):
+        s = jnp.sum(jnp.square(g.astype(jnp.float32)))
+        if tp_axis in getattr(jax.typeof(g), "vma", ()):
+            local_sharded = local_sharded + s
+        else:
+            replicated = replicated + s
+    return jax.lax.psum(local_sharded, tp_axis) + replicated
+
+
+def global_grad_norm(grads: Any, tp_axis: str = "tp") -> jax.Array:
+    return jnp.sqrt(_leaf_sqsum_partitioned(grads, tp_axis))
+
+
+def clip_by_global_norm(grads: Any, max_norm: float, tp_axis: str = "tp"):
+    """Returns (clipped_grads, pre_clip_norm)."""
+    norm = global_grad_norm(grads, tp_axis)
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
+    return jax.tree.map(lambda g: g * scale, grads), norm
+
+
+def batch_specs(with_cp: bool = True) -> Dict[str, P]:
+    """Sharding of the host-global step batch [accum, dp*micro, seq]."""
+    seq_axis = "cp" if with_cp else None
+    return {
+        "input_ids": P(None, "dp", seq_axis),
+        "target_ids": P(None, "dp", seq_axis),
+        "position_ids": P(None, seq_axis),
+    }
+
+
+def make_spmd_train_step(
+    mm: MeshManager,
+    model_forward: Callable,
+    model_cfg,
+    tx: optax.GradientTransformation,
+    params: Any,
+    *,
+    attention_backend: str = "sdpa",
+    gradient_checkpointing: bool = False,
+    sequence_parallel: bool = False,
+    max_grad_norm: float = 0.0,
+    donate: bool = True,
+) -> Tuple[Callable, Any, Any]:
+    """Build the jitted 5D train step.
+
+    Returns ``(step_fn, param_specs, opt_specs)``; the caller shards
+    params/opt_state with the returned specs (device_put with
+    NamedSharding) and feeds host-global batches.
+
+    ``tx`` must NOT include a clip transform — clipping is done here with
+    the tensor-parallel-correct global norm (pass include_clip=False to
+    create_optimizer).
+    """
+    p_specs = llama_param_specs(model_cfg, tp_axis="tp")
+    o_specs = opt_state_specs(tx, params, p_specs)
+    b_specs = batch_specs()
+
+    def loss_fn(p, mb):
+        logits = model_forward(
+            p,
+            mb["input_ids"],
+            model_cfg,
+            positions=mb["position_ids"],
+            attention_backend=attention_backend,
+            gradient_checkpointing=gradient_checkpointing,
+            tp_axis="tp",
+            sequence_parallel=sequence_parallel,
+        )
+        return vocab_parallel_cross_entropy(logits, mb["target_ids"], axis="tp")
+
+    all_axes = DATA_AXES + ("tp",)
+
+    def step(p, opt_state, batch):
+        accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
+
+        # Broadcast every leaf to varying over (dp, cp, tp) BEFORE the
+        # microbatch loop. Differentiating w.r.t. these pre-varied params
+        # keeps every backward collective-free (the broadcast's psum
+        # transpose would otherwise fire per microbatch), so accumulation
+        # is purely local and the reduction below runs ONCE per step —
+        # the no_sync + single-bucket-flush contract
+        # (reference data_parallel.py:46-68, bucket.py:58-77).
+        replicated_over_tp = [
+            "tp" not in getattr(jax.typeof(x), "vma", ())
+            for x in jax.tree_util.tree_leaves(p)
+        ]
+        from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+
+        p_v = jax.tree.map(lambda x: pvary_missing(x, all_axes), p)
+
+        def micro_step(carry, mb):
+            g_acc, l_acc = carry
+            loss, grads = jax.value_and_grad(loss_fn)(p_v, mb)
+            return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+
+        zeros = jax.tree.map(
+            lambda x: jax.lax.pvary(
+                jnp.zeros(x.shape, jnp.float32),
+                tuple(getattr(jax.typeof(x), "vma", ())),
+            ),
+            p_v,
+        )
+        (grads, loss_sum), _ = jax.lax.scan(
+            micro_step, (zeros, jax.lax.pvary(jnp.float32(0.0), all_axes)), batch
+        )
+        grads = jax.tree.map(lambda g: g / accum, grads)
+        loss = loss_sum / accum
+
+        # THE gradient reduction: mean over the fused data group (cp_dp_group
+        # parity), plus a sum over tp for tp-replicated leaves whose shards
+        # each contributed a partial gradient (the reference g-function
+        # all-reduce, folded into the same single reduction point).
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        reduced = []
+        for g, rep_tp in zip(leaves, replicated_over_tp):
+            g = jax.lax.pmean(g, DATA_AXES)
+            if rep_tp:
+                g = jax.lax.psum(g, "tp")
+            reduced.append(g)
+        grads = jax.tree_util.tree_unflatten(treedef, reduced)
+        loss = jax.lax.pmean(loss, all_axes)
+
+        if max_grad_norm and max_grad_norm > 0:
+            grads, grad_norm = clip_by_global_norm(grads, max_grad_norm, "tp")
+        else:
+            grad_norm = global_grad_norm(grads, "tp")
+
+        updates, opt_state = tx.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        return p, opt_state, {"loss": loss, "grad_norm": grad_norm}
+
+    sharded = jax.shard_map(
+        step,
+        mesh=mm.mesh,
+        in_specs=(p_specs, o_specs, b_specs),
+        out_specs=(p_specs, o_specs, P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return (
+        jax.jit(sharded, donate_argnums=donate_argnums),
+        p_specs,
+        o_specs,
+    )
+
+
+def shard_params(mm: MeshManager, params: Any, p_specs: Any) -> Any:
+    return jax.device_put(
+        params, jax.tree.map(lambda s: NamedSharding(mm.mesh, s), p_specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    )

@@ -1,0 +1,227 @@
+"""Tensor parallelism: Megatron-style column/row/vocab-parallel ops.
+
+Parity with reference scaletorch/parallel/tensor_parallel/
+(tensor_parallel.py:147-507 layers, tp_comms.py:64-360 autograd comms),
+re-designed for shard_map:
+
+  * The reference surgically replaces nn.Linear modules and pairs them
+    with hand-written autograd Functions (f/g: CopyToModelParallelRegion /
+    ReduceFromModelParallelRegion / GatherFromModelParallelRegion, plus
+    LinearWithAsyncAllReduce overlapping the grad-input all-reduce with
+    the weight-grad matmul).
+  * Here each layer is a pure function over **locally-sharded** operands
+    executed inside ``shard_map``. JAX's varying-axis machinery derives
+    the transpose collectives automatically (the VJP of a replicated->
+    varying broadcast is exactly the reference's g-function all-reduce),
+    and XLA's latency-hiding scheduler overlaps the backward all-reduce
+    with the weight-gradient matmul — the async-overlap the reference
+    implements by hand in LinearWithAsyncAllReduce (tp_comms.py:229-320).
+
+Weight layouts are [in, out] (einsum-friendly), sharded per
+``llama_param_specs``: column-parallel weights split the output dim over
+'tp', row-parallel split the input dim, the embedding splits the vocab
+rows (VocabParallelEmbedding parity, tensor_parallel.py:375-507).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def axis_rank(axis: str) -> jax.Array:
+    return jax.lax.axis_index(axis)
+
+
+def axis_size(axis: str) -> int:
+    return jax.lax.axis_size(axis)
+
+
+# ---- f/g region functions (tp_comms.py parity) ------------------------------
+def pvary_missing(x: jax.Array, axes) -> jax.Array:
+    """Mark ``x`` as varying over any of ``axes`` it isn't already varying
+    over (shard_map VMA bookkeeping); no-op outside shard_map. The
+    transpose of this broadcast is a psum — exactly the reference's
+    g-function gradient all-reduce (tp_comms.py:64-114) — so replicated
+    operands used inside a shard_map get correctly summed gradients."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    try:
+        vma = jax.typeof(x).vma
+    except AttributeError:  # outside shard_map / non-VMA trace
+        return x
+    missing = tuple(a for a in axes if a not in vma)
+    return jax.lax.pvary(x, missing) if missing else x
+
+
+def copy_to_tensor_parallel_region(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """Identity forward / all-reduce backward (reference tp_comms.py:64-114).
+
+    In shard_map terms: mark a replicated activation as varying over the tp
+    axis so its cotangent is psum'd. ``jax.lax.pvary``'s transpose IS the
+    g-function all-reduce. Idempotent on already-varying inputs.
+    """
+    return pvary_missing(x, axis)
+
+
+def reduce_from_tensor_parallel_region(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """All-reduce forward / identity backward (reference tp_comms.py:117-166)."""
+    return jax.lax.psum(x, axis)
+
+
+def gather_from_tensor_parallel_region(x: jax.Array, axis: str = "tp") -> jax.Array:
+    """All-gather last dim forward / split backward (tp_comms.py:169-226)."""
+    return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
+
+
+# ---- parallel layers --------------------------------------------------------
+def column_parallel_linear(
+    x: jax.Array,
+    w_local: jax.Array,
+    *,
+    axis: str = "tp",
+    gather_output: bool = False,
+) -> jax.Array:
+    """y_local = x @ W[:, shard] (reference ColumnParallelLinear,
+    tensor_parallel.py:147-261). ``x`` replicated over tp, output sharded
+    on the last dim (or gathered when gather_output)."""
+    y = copy_to_tensor_parallel_region(x, axis) @ pvary_missing(w_local, axis)
+    if gather_output:
+        y = gather_from_tensor_parallel_region(y, axis)
+    return y
+
+
+def row_parallel_linear(
+    x_local: jax.Array,
+    w_local: jax.Array,
+    *,
+    axis: str = "tp",
+    sequence_parallel: bool = False,
+    seq_dim: int = 1,
+) -> jax.Array:
+    """y = sum_over_tp(x_local @ W[shard, :]) (reference RowParallelLinear,
+    tensor_parallel.py:264-372). With sequence_parallel the sum is a
+    reduce-scatter along the sequence dim instead of an all-reduce
+    (reference :354-359)."""
+    partial = pvary_missing(x_local, axis) @ pvary_missing(w_local, axis)
+    if sequence_parallel:
+        return jax.lax.psum_scatter(partial, axis, scatter_dimension=seq_dim,
+                                    tiled=True)
+    return reduce_from_tensor_parallel_region(partial, axis)
+
+
+def vocab_parallel_embedding(
+    ids: jax.Array,
+    table_local: jax.Array,
+    *,
+    axis: str = "tp",
+    reduce: str = "sum",
+) -> jax.Array:
+    """Row-sharded embedding lookup with OOV masking + all-reduce
+    (reference VocabParallelEmbedding, tensor_parallel.py:375-507).
+
+    ids: global token ids [B, S]; table_local: [V/tp, H].
+    ``reduce='none'`` returns the per-shard partial sums so the caller can
+    fuse the reduction with another collective (the SP path completes it
+    with a sequence reduce-scatter instead — models/llama.py).
+    """
+    vocab_local = table_local.shape[0]
+    offset = axis_rank(axis) * vocab_local
+    in_shard = (ids >= offset) & (ids < offset + vocab_local)
+    local_ids = jnp.where(in_shard, ids - offset, 0)
+    emb = jnp.take(table_local, local_ids, axis=0)
+    emb = jnp.where(in_shard[..., None], emb, 0)
+    if reduce == "none":
+        return emb
+    return jax.lax.psum(emb, axis)
+
+
+def vocab_parallel_cross_entropy(
+    logits_local: jax.Array,
+    targets: jax.Array,
+    *,
+    axis: str = "tp",
+    ignore_index: int = -100,
+) -> jax.Array:
+    """Cross entropy over vocab-sharded logits without gathering them.
+
+    The TPU-native replacement for gathering final_proj outputs
+    (reference uses gather_output=True on the final ColumnParallelLinear,
+    tensor_parallel.py:107-143): logsumexp and the gold-logit lookup are
+    computed locally and psum'd, so the [B, S, V] logits never
+    materialise unsharded — the standard Megatron vocab-parallel loss.
+    """
+    logits32 = logits_local.astype(jnp.float32)
+    vocab_local = logits32.shape[-1]
+    offset = axis_rank(axis) * vocab_local
+
+    # global logsumexp from local pieces (subtract global max for stability;
+    # the max shift is gradient-free, and pmax has no differentiation rule,
+    # so stop_gradient both silences autodiff and states the math)
+    local_max = jax.lax.stop_gradient(jnp.max(logits32, axis=-1))
+    global_max = jax.lax.pmax(local_max, axis)
+    sumexp = jnp.sum(jnp.exp(logits32 - global_max[..., None]), axis=-1)
+    logz = global_max + jnp.log(jax.lax.psum(sumexp, axis))
+
+    mask = targets != ignore_index
+    safe_targets = jnp.where(mask, targets, 0)
+    in_shard = (safe_targets >= offset) & (safe_targets < offset + vocab_local)
+    local_t = jnp.where(in_shard, safe_targets - offset, 0)
+    gold_local = jnp.take_along_axis(logits32, local_t[..., None], axis=-1)[..., 0]
+    gold = jax.lax.psum(jnp.where(in_shard, gold_local, 0.0), axis)
+
+    nll = (logz - gold) * mask
+    denom = jnp.maximum(mask.sum(), 1)
+    return nll.sum() / denom
+
+
+# ---- sharding rules ---------------------------------------------------------
+def validate_tp_divisibility(cfg, tp: int) -> None:
+    """Reference apply_tensor_parallel's implicit requirements
+    (tensor_parallel.py:107-143): every split dim divisible by tp."""
+    checks = {
+        "num_attention_heads": cfg.num_attention_heads,
+        "num_key_value_heads": cfg.num_key_value_heads,
+        "intermediate_size": cfg.intermediate_size,
+        "vocab_size": cfg.vocab_size,
+    }
+    for name, value in checks.items():
+        if value % tp != 0:
+            raise ValueError(f"{name}={value} not divisible by tp={tp}")
+
+
+def llama_param_specs(cfg, *, tp_axis: Optional[str] = "tp") -> dict:
+    """PartitionSpec pytree for Llama/Qwen3 params — the declarative
+    equivalent of the reference's module-replacement map
+    (tensor_parallel.py:25,107-143):
+      q/k/v/gate/up -> column (output dim over tp)
+      o/down        -> row (input dim over tp)
+      embedding     -> vocab rows over tp; lm_head -> vocab cols over tp
+      norms         -> replicated
+    """
+    t = tp_axis
+    layers = {
+        "input_layernorm": P(None, None),
+        "q_proj": P(None, None, t),
+        "k_proj": P(None, None, t),
+        "v_proj": P(None, None, t),
+        "o_proj": P(None, t, None),
+        "post_attention_layernorm": P(None, None),
+        "gate_proj": P(None, None, t),
+        "up_proj": P(None, None, t),
+        "down_proj": P(None, t, None),
+    }
+    if cfg.qk_norm:
+        layers["q_norm"] = P(None, None)
+        layers["k_norm"] = P(None, None)
+    specs = {
+        "embed_tokens": P(t, None),
+        "layers": layers,
+        "norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, t)
+    return specs

@@ -16,11 +16,18 @@ import optax
 
 
 def create_optimizer(
-    cfg, schedule: Optional[optax.Schedule] = None
+    cfg,
+    schedule: Optional[optax.Schedule] = None,
+    include_clip: bool = True,
 ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
     """cfg needs: optimizer_name, learning_rate, weight_decay, adam_beta1/2,
     adam_epsilon, max_grad_norm, momentum (+ scheduler fields if schedule
-    is None)."""
+    is None).
+
+    ``include_clip=False`` omits the clip-by-global-norm prologue — the
+    SPMD train step applies its own tensor-parallel-correct clipping
+    (parallel/spmd.py) and must not clip twice.
+    """
     if schedule is None:
         from scaletorch_tpu.trainer.lr_scheduler import create_lr_scheduler
 
@@ -54,6 +61,6 @@ def create_optimizer(
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer_name!r}")
 
-    if getattr(cfg, "max_grad_norm", 0) and cfg.max_grad_norm > 0:
+    if include_clip and getattr(cfg, "max_grad_norm", 0) and cfg.max_grad_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(cfg.max_grad_norm), tx)
     return tx, schedule

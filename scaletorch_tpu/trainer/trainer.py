@@ -14,7 +14,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding
 
 from scaletorch_tpu.config import ScaleTorchTPUArguments
 from scaletorch_tpu.models import llama, qwen3
@@ -22,7 +22,6 @@ from scaletorch_tpu.models.registry import resolve_attention_backend
 from scaletorch_tpu.parallel.mesh import MeshManager, setup_mesh_manager
 from scaletorch_tpu.trainer.metrics import MetricsLogger
 from scaletorch_tpu.trainer.optimizer import create_optimizer
-from scaletorch_tpu.trainer.train_step import make_train_step
 from scaletorch_tpu.utils.logger import get_logger
 from scaletorch_tpu.utils.misc import get_num_params, set_all_seed, to_readable_format
 
@@ -108,34 +107,43 @@ class Trainer:
             cfg.attention_backend, context_parallel=cfg.context_parallel_size > 1
         )
 
+        from scaletorch_tpu.parallel.spmd import (
+            batch_specs,
+            make_spmd_train_step,
+            shard_params,
+        )
+        from scaletorch_tpu.parallel.tensor_parallel import validate_tp_divisibility
+
+        if cfg.tensor_parallel_size > 1:
+            validate_tp_divisibility(self.model_cfg, cfg.tensor_parallel_size)
+
         key = set_all_seed(cfg.seed)
-        # Initialise params replicated over the mesh (TP sharding rules are
-        # applied by the parallel layers in the explicit path).
         with jax.default_device(jax.devices()[0]):
-            self.params = llama.init_params(key, self.model_cfg)
-        self.params = jax.device_put(
-            self.params, NamedSharding(self.mm.mesh, P())
-        )
+            params_host = llama.init_params(key, self.model_cfg)
 
-        self.tx, self.schedule = create_optimizer(cfg)
-        self.opt_state = jax.device_put(
-            self.tx.init(self.params), NamedSharding(self.mm.mesh, P())
-        )
+        # clip-free optimizer: the SPMD step applies TP-correct clipping
+        self.tx, self.schedule = create_optimizer(cfg, include_clip=False)
 
-        self.loader = build_dataloader(cfg, self.model_cfg)
-        # batch leaves: [accum, dp*micro, seq] -> shard batch dim over dp
-        # (and sequence over cp once ring attention lands).
-        self.data_sharding = NamedSharding(self.mm.mesh, P(None, "dp", None))
-        self.pos_sharding = NamedSharding(self.mm.mesh, P(None, None))
-
-        self.step_fn = make_train_step(
+        self.step_fn, p_specs, o_specs = make_spmd_train_step(
+            self.mm,
             llama.forward,
             self.model_cfg,
             self.tx,
+            params_host,
             attention_backend=self.attention_backend,
             gradient_checkpointing=cfg.gradient_checkpointing,
+            sequence_parallel=cfg.sequence_parallel,
+            max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
         )
+        self.params = shard_params(self.mm, params_host, p_specs)
+        self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)
+
+        self.loader = build_dataloader(cfg, self.model_cfg)
+        # batch leaves: [accum, dp*micro, seq] with batch over dp, seq over cp
+        self._batch_shardings = {
+            k: NamedSharding(self.mm.mesh, spec) for k, spec in batch_specs().items()
+        }
 
         n_params = get_num_params(self.params)
         self.metrics = MetricsLogger(
@@ -170,11 +178,10 @@ class Trainer:
         return self._ckpt_mgr
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
-        out = {}
-        for k, v in batch.items():
-            sharding = self.pos_sharding if k == "position_ids" else self.data_sharding
-            out[k] = jax.device_put(jnp.asarray(v), sharding)
-        return out
+        return {
+            k: jax.device_put(jnp.asarray(v), self._batch_shardings[k])
+            for k, v in batch.items()
+        }
 
     def train(self, num_steps: Optional[int] = None) -> Dict[str, Any]:
         num_steps = num_steps or self.cfg.total_train_steps
