@@ -42,6 +42,7 @@ from scaletorch_tpu.models.registry import (
     get_attention_backend,
     register_attention_backend,
 )
+from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
 Params = Dict[str, Any]
 
@@ -152,11 +153,6 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     if not cfg.tie_word_embeddings:
         params["lm_head"] = fan_in_uniform(keys[8], (h, v), h, pd)
     return params
-
-
-# Re-exported for backwards compatibility; canonical home is
-# parallel/tensor_parallel.py.
-from scaletorch_tpu.parallel.tensor_parallel import pvary_missing  # noqa: E402
 
 
 def _decoder_layer(
@@ -275,6 +271,9 @@ def forward(
     """
     cdt = cfg.dtype
     s = input_ids.shape[1]
+
+    if sequence_parallel and tp_axis is None:
+        raise ValueError("sequence_parallel requires tp_axis (run inside shard_map)")
 
     if tp_axis is None:
         x = params["embed_tokens"][input_ids].astype(cdt)  # [B, S, H]

@@ -1,0 +1,227 @@
+"""Ring attention — context parallelism over the ``cp`` mesh axis.
+
+Capability parity with reference scaletorch/parallel/context_parallel/
+context_parallel.py:83-515 (RingAttentionFunc + blockwise math from
+zhuzilin/ring-flash-attention), re-designed for TPU SPMD:
+
+  * the K/V blocks circulate the cp ring with ``lax.ppermute`` (the
+    reference queues isend/irecv pairs per step, cp_comms.py:117-176);
+  * blockwise softmax uses flash-style running-max/sum accumulation in
+    fp32 (the reference's sigmoid/logsigmoid LSE merge,
+    context_parallel.py:367-424, is the same recurrence);
+  * the **causal skip** halves compute: with contiguous sequence shards,
+    a query shard r never attends key shards j > r, so those steps run a
+    ``lax.cond`` no-op branch (reference skips step>rank blocks,
+    :154-171);
+  * the backward is a ``jax.custom_vjp`` that re-circulates K/V together
+    with the dK/dV accumulators — after cp rotations each accumulator is
+    home with every rank's contribution (the reference's dual kv/dkv
+    ring, :184-263). Without the custom vjp, autodiff through the
+    forward ring would checkpoint every rotated K/V block and the memory
+    saving of CP would be lost.
+
+Inputs are the rank-local sequence shards [B, H, S/cp, D] (the loader
+ships contiguous shards; positions arrive via the sharded position_ids).
+GQA: K/V circulate **unexpanded** (fewer bytes on the ring) and are
+expanded per block.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scaletorch_tpu.models.layers import repeat_kv
+from scaletorch_tpu.models.registry import register_attention_backend
+
+
+def _ring_perm(axis: str):
+    n = jax.lax.axis_size(axis)
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _block_scores(q, k, scale):
+    # q: [B, Hq, Sq, D]; k: [B, Hq, Sk, D] (pre-expanded) -> fp32 scores
+    return jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+
+
+def _causal_mask(sq: int, sk: int):
+    return jnp.tril(jnp.ones((sq, sk), dtype=bool))
+
+
+def _fwd_block(q, k, v, scale, causal_diag: bool):
+    """One blockwise attention piece -> (unnormalised acc, rowmax m, rowsum l)."""
+    s = _block_scores(q, k, scale)
+    if causal_diag:
+        s = jnp.where(_causal_mask(s.shape[-2], s.shape[-1]), s, -jnp.inf)
+    m = jnp.max(s, axis=-1)                      # [B, H, Sq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
+    return acc, m, l
+
+
+def _merge(acc, m, l, acc2, m2, l2):
+    """Merge two flash-style partial results (fp32)."""
+    m_new = jnp.maximum(m, m2)
+    w1 = jnp.exp(m - m_new)
+    w2 = jnp.exp(m2 - m_new)
+    return (
+        acc * w1[..., None] + acc2 * w2[..., None],
+        m_new,
+        l * w1 + l2 * w2,
+    )
+
+
+def _ring_forward(q, k, v, axis: str, scale: float):
+    """Returns (out [B,H,S,D] in q.dtype, lse fp32 [B,H,S])."""
+    cp = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    n_rep = q.shape[1] // k.shape[1]
+    perm = _ring_perm(axis)
+
+    # step 0: the diagonal (own) block, causal-masked — every query row sees
+    # at least itself, so accumulators start finite.
+    acc, m, l = _fwd_block(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), scale, True)
+
+    k_blk, v_blk = k, v
+    for t in range(1, cp):
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        j = (r - t) % cp  # origin rank of the block now held
+
+        def attend(acc=acc, m=m, l=l, k_blk=k_blk, v_blk=v_blk):
+            a2, m2, l2 = _fwd_block(
+                q, repeat_kv(k_blk, n_rep), repeat_kv(v_blk, n_rep), scale, False
+            )
+            return _merge(acc, m, l, a2, m2, l2)
+
+        def skip(acc=acc, m=m, l=l):
+            return acc, m, l
+
+        # causal skip: key shard j holds positions AFTER ours when j > r
+        acc, m, l = jax.lax.cond(j < r, attend, skip)
+
+    out = (acc / l[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l)
+    return out, lse
+
+
+def _bwd_block(q, k, v, dout, lse, delta, scale, causal_diag: bool):
+    """Gradients of one block: (dq, dk, dv) in fp32.
+
+    Standard flash backward: p = exp(s - lse); dv = p^T dout;
+    ds = p * (dout v^T - delta) * scale; dq = ds k; dk = ds^T q.
+    """
+    s = _block_scores(q, k, scale)
+    if causal_diag:
+        s = jnp.where(_causal_mask(s.shape[-2], s.shape[-1]), s, -jnp.inf)
+    p = jnp.exp(s - lse[..., None])              # [B,H,Sq,Sk] fp32
+    dout32 = dout.astype(jnp.float32)
+    v32 = v.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dout32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dout32, v32)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq, dk, dv
+
+
+def _sum_heads(d_expanded, n_rep):
+    """Fold gradients of GQA-expanded heads back onto kv heads."""
+    if n_rep == 1:
+        return d_expanded
+    b, h, s, d = d_expanded.shape
+    return d_expanded.reshape(b, h // n_rep, n_rep, s, d).sum(axis=2)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(q, k, v, axis: str = "cp", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Ring attention over mesh axis ``axis``; call inside shard_map.
+
+    q: [B, Hq, S/cp, D]; k/v: [B, Hkv, S/cp, D] (local shards).
+    Only causal=True is supported (parity: the reference ring attention
+    is causal-only, context_parallel.py:154-171).
+    """
+    if not causal:
+        raise NotImplementedError("ring attention is causal-only")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    out, _ = _ring_forward(q, k, v, axis, scale)
+    return out
+
+
+def _ring_fwd(q, k, v, axis, causal, scale):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    out, lse = _ring_forward(q, k, v, axis, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis, causal, scale, res, dout):
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    cp = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    n_rep = q.shape[1] // k.shape[1]
+    perm = _ring_perm(axis)
+
+    # delta = rowsum(dout * out) — the softmax-jacobian diagonal term
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    # own (diagonal) block
+    dq, dk_own, dv_own = _bwd_block(
+        q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), dout, lse, delta, scale, True
+    )
+    dk_acc = _sum_heads(dk_own, n_rep)
+    dv_acc = _sum_heads(dv_own, n_rep)
+
+    # Rotate (k, v, dk, dv) together: after the remaining cp-1 rotations
+    # plus one final rotation, each dk/dv accumulator is back at its origin
+    # with every rank's contribution (reference dual-ring, :184-263).
+    k_blk, v_blk = k, v
+    for t in range(1, cp):
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+        j = (r - t) % cp
+
+        def contribute(dq=dq, dk_acc=dk_acc, dv_acc=dv_acc,
+                       k_blk=k_blk, v_blk=v_blk):
+            dq_c, dk_c, dv_c = _bwd_block(
+                q, repeat_kv(k_blk, n_rep), repeat_kv(v_blk, n_rep),
+                dout, lse, delta, scale, False,
+            )
+            return (dq + dq_c,
+                    dk_acc + _sum_heads(dk_c, n_rep),
+                    dv_acc + _sum_heads(dv_c, n_rep))
+
+        def skip(dq=dq, dk_acc=dk_acc, dv_acc=dv_acc):
+            return dq, dk_acc, dv_acc
+
+        dq, dk_acc, dv_acc = jax.lax.cond(j < r, contribute, skip)
+
+    # one final rotation brings every accumulator home
+    dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_attention_backend(q, k, v, *, causal: bool = True,
+                           scale: Optional[float] = None, axis: str = "cp"):
+    """Registry-compatible wrapper (backend name 'ring')."""
+    return ring_attention(q, k, v, axis, causal, scale)
+
+
+register_attention_backend("ring", ring_attention_backend)

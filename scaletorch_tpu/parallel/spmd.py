@@ -26,8 +26,8 @@ expert_parallel) — the spmd step accepts a stage-local forward for PP.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Any, Callable, Dict, Optional, Tuple
+
+from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

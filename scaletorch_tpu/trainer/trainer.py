@@ -106,6 +106,13 @@ class Trainer:
         self.attention_backend = resolve_attention_backend(
             cfg.attention_backend, context_parallel=cfg.context_parallel_size > 1
         )
+        if cfg.context_parallel_size > 1 and self.attention_backend != "ring":
+            # A full-sequence backend on cp-sharded activations would silently
+            # compute block-diagonal attention.
+            raise ValueError(
+                f"context_parallel_size={cfg.context_parallel_size} requires the "
+                f"'ring' attention backend, got {self.attention_backend!r}"
+            )
 
         from scaletorch_tpu.parallel.spmd import (
             batch_specs,
