@@ -1,0 +1,103 @@
+"""Headline benchmark: Qwen3-0.6B single-chip pretraining throughput.
+
+Mirrors the reference's headline single-device row — Qwen3-0.6B,
+seq 8192, micro-batch 1, gradient checkpointing, bf16 — which achieved
+9,834 tok/s at 39.0% MFU on one Ascend 910B (BASELINE.md, reference
+README.md:31). MFU is the hardware-normalised comparison: we report our
+MFU on whatever single TPU chip the driver provides and compare against
+the reference's 39.0% at the identical model/sequence configuration.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "...", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+# Benchmark wants the real chip; nothing here should touch the test env.
+os.environ.setdefault("XLA_PYTHON_CLIENT_MEM_FRACTION", "0.92")
+
+BASELINE_MFU = 39.0  # reference Qwen3-0.6B seq8192 BS1 GC on 910B (README.md:31)
+
+# Qwen3-0.6B architecture (HF Qwen/Qwen3-0.6B config).
+QWEN3_0_6B = dict(
+    model_type="qwen3",
+    vocab_size=151936,
+    hidden_size=1024,
+    intermediate_size=3072,
+    num_hidden_layers=28,
+    num_attention_heads=16,
+    num_key_value_heads=8,
+    head_dim=128,
+    tie_word_embeddings=True,
+    rope_theta=1e6,
+)
+
+
+def main() -> None:
+    import jax
+
+    from scaletorch_tpu.config import ScaleTorchTPUArguments
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    seq_len = int(os.environ.get("BENCH_SEQ_LEN", 8192))
+    warmup = int(os.environ.get("BENCH_WARMUP_STEPS", 3))
+    steps = int(os.environ.get("BENCH_STEPS", 10))
+
+    cfg = ScaleTorchTPUArguments(
+        **QWEN3_0_6B,
+        sequence_length=seq_len,
+        micro_batch_size=1,
+        gradient_accumulation_steps=1,
+        gradient_checkpointing=True,
+        synthetic_data=True,
+        dtype="bfloat16",
+        total_train_steps=warmup + steps,
+        log_frequency=10_000,  # silence per-step logging during timing
+        max_grad_norm=1.0,
+    )
+
+    trainer = Trainer(cfg)
+    trainer.train(num_steps=warmup)  # compile + stabilise
+    jax.block_until_ready(trainer.params)
+
+    t0 = time.perf_counter()
+    trainer.train(num_steps=steps)
+    jax.block_until_ready(trainer.params)
+    elapsed = time.perf_counter() - t0
+
+    tok_s = trainer.loader.tokens_per_step * steps / elapsed
+
+    from scaletorch_tpu.utils.misc import get_mfu, get_num_params
+
+    mfu = get_mfu(
+        tok_s,
+        get_num_params(trainer.params),
+        trainer.model_cfg.num_hidden_layers,
+        trainer.model_cfg.num_attention_heads,
+        trainer.model_cfg.actual_head_dim,
+        seq_len,
+        num_chips=len(jax.devices()),
+    )
+    result = {
+        "metric": "qwen3-0.6b_seq8192_bs1_gc_single_chip_mfu",
+        "value": round(mfu, 2),
+        "unit": "% MFU",
+        "vs_baseline": round(mfu / BASELINE_MFU, 3),
+        "tokens_per_second": round(tok_s, 1),
+        "device": jax.devices()[0].device_kind,
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — the driver needs a JSON line either way
+        print(json.dumps({"metric": "error", "value": 0, "unit": "",
+                          "vs_baseline": 0, "error": repr(e)}))
+        sys.exit(1)

@@ -256,6 +256,7 @@ def forward(
     gradient_checkpointing: bool = False,
     tp_axis: Optional[str] = None,
     sequence_parallel: bool = False,
+    return_hidden: bool = False,
 ) -> jax.Array:
     """Full decoder forward: [B, S] int tokens -> logits.
 
@@ -325,14 +326,23 @@ def forward(
         from scaletorch_tpu.parallel.sequence_parallel import all_gather_sequence
 
         x = all_gather_sequence(x, tp_axis)
+    if return_hidden:
+        # Caller applies the LM head via lm_head_weight() (e.g. the fused
+        # chunked CE in parallel/spmd.py).
+        return x
+    return x @ lm_head_weight(params, cfg, tp_axis)
+
+
+def lm_head_weight(
+    params: Params, cfg: LlamaConfig, tp_axis: Optional[str] = None
+) -> jax.Array:
+    """[H, V(/tp)] head weight in compute dtype (tied-embedding aware)."""
     head = (
-        params["embed_tokens"].astype(cdt).T
+        params["embed_tokens"].astype(cfg.dtype).T
         if cfg.tie_word_embeddings
-        else params["lm_head"].astype(cdt)
+        else params["lm_head"].astype(cfg.dtype)
     )
-    if tp_axis is not None:
-        head = pvary_missing(head, tp_axis)
-    return x @ head
+    return pvary_missing(head, tp_axis) if tp_axis else head
 
 
 class Llama:

@@ -1,20 +1,19 @@
-"""Flash attention backend.
+"""Flash attention backend — Pallas blockwise kernel on TPU.
 
 The role the reference fills with flash-attn 2 / Ascend's
 ``npu_flash_attn_func`` (reference models/attention_utils.py:72-122) is on
-TPU a Pallas blockwise-softmax kernel. Until the custom kernel lands
-(ops/pallas/flash.py), this module provides the dispatch surface and an
-XLA fallback: XLA already fuses QK^T -> softmax -> PV reasonably well on
-TPU, so the fallback is correct and fast-ish; the Pallas kernel removes
-the O(S^2) score materialisation in HBM.
-
-Selection: 'flash' backend -> pallas kernel on TPU unless
-SCALETORCH_TPU_DISABLE_PALLAS=1 or the platform is CPU (tests), in which
-case the XLA fallback runs.
+TPU a Pallas blockwise-softmax kernel: QK^T tiles stream through VMEM with
+running-max/sum accumulation, so the O(S^2) score matrix never
+materialises in HBM, and the custom VJP recomputes tiles in the backward.
+The kernel lives in scaletorch_tpu/ops/pallas/flash.py (GQA-aware — KV
+heads are read unexpanded via index maps); this module is the dispatch
+surface, with an XLA softmax fallback on CPU (tests) or when
+``SCALETORCH_TPU_DISABLE_PALLAS=1``.
 """
 
 from __future__ import annotations
 
+import math
 from typing import Optional
 
 import jax
@@ -39,13 +38,12 @@ def flash_attention(
     scale: Optional[float] = None,
 ) -> jax.Array:
     """[B, Hq, S, D] x [B, Hkv, S, D]^2 -> [B, Hq, S, D]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
     if _pallas_available():
-        try:
-            from scaletorch_tpu.ops.pallas.flash import pallas_flash_attention
+        from scaletorch_tpu.ops.pallas.flash import pallas_flash_attention
 
-            return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
-        except ImportError:
-            pass  # kernel not built yet; fall through to XLA
+        return pallas_flash_attention(q, k, v, causal=causal, scale=scale)
     return sdpa_attention(q, k, v, causal=causal, scale=scale)
 
 

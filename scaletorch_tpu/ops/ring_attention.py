@@ -157,6 +157,10 @@ def ring_attention(q, k, v, axis: str = "cp", causal: bool = True,
 
 
 def _ring_fwd(q, k, v, axis, causal, scale):
+    # guard repeated here: under differentiation custom_vjp traces this
+    # function instead of the primal body above
+    if not causal:
+        raise NotImplementedError("ring attention is causal-only")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     out, lse = _ring_forward(q, k, v, axis, scale)

@@ -36,8 +36,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from scaletorch_tpu.parallel.mesh import DATA_AXES, MeshManager
 from scaletorch_tpu.parallel.tensor_parallel import (
+    fused_vocab_parallel_cross_entropy,
     llama_param_specs,
-    vocab_parallel_cross_entropy,
 )
 
 
@@ -101,6 +101,8 @@ def make_spmd_train_step(
     sequence_parallel: bool = False,
     max_grad_norm: float = 0.0,
     donate: bool = True,
+    head_weight_fn: Optional[Callable] = None,
+    param_specs: Any = None,
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -111,13 +113,26 @@ def make_spmd_train_step(
     ``tx`` must NOT include a clip transform — clipping is done here with
     the tensor-parallel-correct global norm (pass include_clip=False to
     create_optimizer).
+
+    Model contract: ``model_forward`` must accept ``return_hidden=True``
+    (returns [B, S, H] pre-head hidden states) and ``head_weight_fn(params,
+    model_cfg, tp_axis)`` must return the [H, V/tp] head weight — defaults
+    to the Llama/Qwen3 accessors; pass both (plus ``param_specs``) for
+    other model families.
     """
-    p_specs = llama_param_specs(model_cfg, tp_axis="tp")
+    p_specs = (
+        param_specs
+        if param_specs is not None
+        else llama_param_specs(model_cfg, tp_axis="tp")
+    )
     o_specs = opt_state_specs(tx, params, p_specs)
     b_specs = batch_specs()
 
+    if head_weight_fn is None:
+        from scaletorch_tpu.models.llama import lm_head_weight as head_weight_fn
+
     def loss_fn(p, mb):
-        logits = model_forward(
+        hidden = model_forward(
             p,
             mb["input_ids"],
             model_cfg,
@@ -126,8 +141,14 @@ def make_spmd_train_step(
             gradient_checkpointing=gradient_checkpointing,
             tp_axis="tp",
             sequence_parallel=sequence_parallel,
+            return_hidden=True,
         )
-        return vocab_parallel_cross_entropy(logits, mb["target_ids"], axis="tp")
+        # Head + CE fused over sequence chunks: full [B, S, V] logits never
+        # materialise (vocab-parallel over tp AND chunk-rematerialised).
+        head = head_weight_fn(p, model_cfg, "tp")
+        return fused_vocab_parallel_cross_entropy(
+            hidden, head, mb["target_ids"], axis="tp"
+        )
 
     all_axes = DATA_AXES + ("tp",)
 

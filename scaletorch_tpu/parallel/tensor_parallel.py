@@ -139,6 +139,44 @@ def vocab_parallel_embedding(
     return jax.lax.psum(emb, axis)
 
 
+def _vocab_parallel_token_stats(
+    logits_local: jax.Array,
+    targets: jax.Array,
+    axis: Optional[str],
+    ignore_index: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Shared Megatron vocab-parallel CE core: (nll_sum, token_count), fp32.
+
+    logsumexp and the gold-logit lookup are computed on the local vocab
+    shard and psum'd (axis=None skips the collectives — single-device
+    semantics). The max shift is gradient-free, and pmax has no
+    differentiation rule, so stop_gradient both silences autodiff and
+    states the math. Used by both the unfused and the chunk-fused loss so
+    the numerically delicate parts exist exactly once.
+    """
+    logits32 = logits_local.astype(jnp.float32)
+    vocab_local = logits32.shape[-1]
+    offset = axis_rank(axis) * vocab_local if axis is not None else 0
+
+    local_max = jax.lax.stop_gradient(jnp.max(logits32, axis=-1))
+    global_max = jax.lax.pmax(local_max, axis) if axis else local_max
+    sumexp = jnp.sum(jnp.exp(logits32 - global_max[..., None]), axis=-1)
+    if axis:
+        sumexp = jax.lax.psum(sumexp, axis)
+    logz = global_max + jnp.log(sumexp)
+
+    mask = targets != ignore_index
+    safe_t = jnp.where(mask, targets, 0)
+    in_shard = (safe_t >= offset) & (safe_t < offset + vocab_local)
+    local_t = jnp.where(in_shard, safe_t - offset, 0)
+    gold = jnp.take_along_axis(logits32, local_t[..., None], axis=-1)[..., 0]
+    gold = jnp.where(in_shard, gold, 0.0)
+    if axis:
+        gold = jax.lax.psum(gold, axis)
+    nll = (logz - gold) * mask
+    return jnp.sum(nll), jnp.sum(mask).astype(jnp.float32)
+
+
 def vocab_parallel_cross_entropy(
     logits_local: jax.Array,
     targets: jax.Array,
@@ -150,32 +188,60 @@ def vocab_parallel_cross_entropy(
 
     The TPU-native replacement for gathering final_proj outputs
     (reference uses gather_output=True on the final ColumnParallelLinear,
-    tensor_parallel.py:107-143): logsumexp and the gold-logit lookup are
-    computed locally and psum'd, so the [B, S, V] logits never
-    materialise unsharded — the standard Megatron vocab-parallel loss.
+    tensor_parallel.py:107-143): the [B, S, V] logits never materialise
+    unsharded — the standard Megatron vocab-parallel loss.
     """
-    logits32 = logits_local.astype(jnp.float32)
-    vocab_local = logits32.shape[-1]
-    offset = axis_rank(axis) * vocab_local
+    nll_sum, count = _vocab_parallel_token_stats(
+        logits_local, targets, axis, ignore_index
+    )
+    return nll_sum / jnp.maximum(count, 1.0)
 
-    # global logsumexp from local pieces (subtract global max for stability;
-    # the max shift is gradient-free, and pmax has no differentiation rule,
-    # so stop_gradient both silences autodiff and states the math)
-    local_max = jax.lax.stop_gradient(jnp.max(logits32, axis=-1))
-    global_max = jax.lax.pmax(local_max, axis)
-    sumexp = jnp.sum(jnp.exp(logits32 - global_max[..., None]), axis=-1)
-    logz = global_max + jnp.log(jax.lax.psum(sumexp, axis))
 
-    mask = targets != ignore_index
-    safe_targets = jnp.where(mask, targets, 0)
-    in_shard = (safe_targets >= offset) & (safe_targets < offset + vocab_local)
-    local_t = jnp.where(in_shard, safe_targets - offset, 0)
-    gold_local = jnp.take_along_axis(logits32, local_t[..., None], axis=-1)[..., 0]
-    gold = jax.lax.psum(jnp.where(in_shard, gold_local, 0.0), axis)
+def fused_vocab_parallel_cross_entropy(
+    hidden: jax.Array,
+    head_local: jax.Array,
+    targets: jax.Array,
+    *,
+    axis: Optional[str] = "tp",
+    chunk_size: int = 1024,
+    ignore_index: int = -100,
+) -> jax.Array:
+    """LM-head matmul + vocab-parallel CE fused over sequence chunks.
 
-    nll = (logz - gold) * mask
-    denom = jnp.maximum(mask.sum(), 1)
-    return nll.sum() / denom
+    Full logits [B, S, V] never materialise: each chunk computes its
+    [B, C, V/tp] logits, reduces them to (nll_sum, count), and the chunk
+    body is rematerialised in the backward (``jax.checkpoint``) so only
+    the [B, C, H] hidden chunk is saved — the difference between fitting
+    and OOM at large vocab (151k × 8k seq fp32 logits alone is ~5 GB).
+
+    hidden: [B, S, H]; head_local: [H, V/tp] (or [H, V] with axis=None);
+    targets: [B, S] global ids.
+    """
+    b, s, h = hidden.shape
+    chunk = min(chunk_size, s)
+    nc = -(-s // chunk)  # ceil: tail chunk may be smaller, memory bound holds
+
+    def chunk_stats(x_chunk, t_chunk):
+        return _vocab_parallel_token_stats(
+            x_chunk @ head_local, t_chunk, axis, ignore_index
+        )
+
+    if nc == 1:
+        nll_sum, count = chunk_stats(hidden, targets)
+        return nll_sum / jnp.maximum(count, 1.0)
+
+    # Static Python loop (nc is small): sidesteps scan-carry vma matching
+    # inside shard_map, and XLA still schedules the chunks sequentially so
+    # only one chunk's logits are live at a time.
+    ckpt_stats = jax.checkpoint(chunk_stats)
+    nll_sum = count = None
+    for c in range(nc):
+        x_c = hidden[:, c * chunk:(c + 1) * chunk, :]
+        t_c = targets[:, c * chunk:(c + 1) * chunk]
+        n, m = ckpt_stats(x_c, t_c)
+        nll_sum = n if nll_sum is None else nll_sum + n
+        count = m if count is None else count + m
+    return nll_sum / jnp.maximum(count, 1.0)
 
 
 # ---- sharding rules ---------------------------------------------------------
