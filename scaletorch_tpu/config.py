@@ -221,6 +221,15 @@ class ScaleTorchTPUArguments(
             )
         if self.num_microbatches is None:
             self.num_microbatches = self.gradient_accumulation_steps
+        elif self.num_microbatches != self.gradient_accumulation_steps:
+            # The batch's accumulation dim IS the pipeline microbatch dim
+            # (one scan feeds both), so a divergent value would silently be
+            # ignored — reject it instead.
+            raise ValueError(
+                f"num_microbatches ({self.num_microbatches}) must equal "
+                f"gradient_accumulation_steps ({self.gradient_accumulation_steps}); "
+                "set gradient_accumulation_steps to control PP microbatching"
+            )
 
     @property
     def world_size(self) -> int:

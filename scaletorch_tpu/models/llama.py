@@ -246,6 +246,96 @@ def _decoder_layer(
     return x
 
 
+def embed(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    tp_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
+) -> jax.Array:
+    """Token embedding: [B, S] -> [B, S(/tp under SP), H] in compute dtype.
+
+    Factored out of ``forward`` so pipeline parallelism can run it on the
+    first stage only (reference PipelineParallel keeps the embedding on
+    stage 0, pipeline_parallel.py:135-178).
+    """
+    cdt = cfg.dtype
+    if sequence_parallel and tp_axis is None:
+        raise ValueError("sequence_parallel requires tp_axis (run inside shard_map)")
+    if tp_axis is None:
+        return params["embed_tokens"][input_ids].astype(cdt)  # [B, S, H]
+    from scaletorch_tpu.parallel.sequence_parallel import reduce_scatter_sequence
+    from scaletorch_tpu.parallel.tensor_parallel import vocab_parallel_embedding
+
+    if sequence_parallel:
+        # Fused all-reduce + seq-scatter: the embedding's partial sums
+        # are completed by the reduce-scatter that enters the SP region
+        # (reference skips the embedding all-reduce under SP the same
+        # way, tensor_parallel.py:238-240 + llama.py:530-552).
+        partial = vocab_parallel_embedding(
+            input_ids, params["embed_tokens"], axis=tp_axis, reduce="none"
+        )
+        return reduce_scatter_sequence(partial.astype(cdt), tp_axis)
+    return vocab_parallel_embedding(
+        input_ids, params["embed_tokens"], axis=tp_axis
+    ).astype(cdt)
+
+
+def final_hidden(
+    params: Params,
+    x: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    tp_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
+) -> jax.Array:
+    """Final RMSNorm (+ SP sequence all-gather): the last-stage epilogue
+    before the LM head (reference keeps final_norm/final_proj on the last
+    PP stage, pipeline_parallel.py:135-178)."""
+    x = rms_norm(
+        x,
+        pvary_missing(params["norm"], tp_axis) if tp_axis else params["norm"],
+        cfg.rms_norm_eps,
+    )
+    if sequence_parallel:
+        from scaletorch_tpu.parallel.sequence_parallel import all_gather_sequence
+
+        x = all_gather_sequence(x, tp_axis)
+    return x
+
+
+def decoder_stack(
+    x: jax.Array,
+    layers: Params,
+    cos: jax.Array,
+    sin: jax.Array,
+    cfg: LlamaConfig,
+    attn_fn: Callable,
+    *,
+    tp_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
+    gradient_checkpointing: bool = False,
+) -> jax.Array:
+    """Scan ``_decoder_layer`` over a stack of layer params (leading axis =
+    layer index). Used by ``forward`` for the whole model and by pipeline
+    parallelism for one stage's layer subset."""
+
+    def layer_body(h, layer_params):
+        h = _decoder_layer(
+            h, layer_params, cos, sin, cfg, attn_fn,
+            tp_axis=tp_axis, sequence_parallel=sequence_parallel,
+        )
+        return h, None
+
+    if gradient_checkpointing:
+        layer_body = jax.checkpoint(
+            layer_body, policy=jax.checkpoint_policies.nothing_saveable
+        )
+    x, _ = jax.lax.scan(layer_body, x, layers)
+    return x
+
+
 def forward(
     params: Params,
     input_ids: jax.Array,
@@ -270,31 +360,9 @@ def forward(
     table — CP passes this rank's sequence-shard positions (reference
     update_rope_for_context_parallel, context_parallel.py:427-473).
     """
-    cdt = cfg.dtype
     s = input_ids.shape[1]
-
-    if sequence_parallel and tp_axis is None:
-        raise ValueError("sequence_parallel requires tp_axis (run inside shard_map)")
-
-    if tp_axis is None:
-        x = params["embed_tokens"][input_ids].astype(cdt)  # [B, S, H]
-    else:
-        from scaletorch_tpu.parallel.sequence_parallel import reduce_scatter_sequence
-        from scaletorch_tpu.parallel.tensor_parallel import vocab_parallel_embedding
-
-        if sequence_parallel:
-            # Fused all-reduce + seq-scatter: the embedding's partial sums
-            # are completed by the reduce-scatter that enters the SP region
-            # (reference skips the embedding all-reduce under SP the same
-            # way, tensor_parallel.py:238-240 + llama.py:530-552).
-            partial = vocab_parallel_embedding(
-                input_ids, params["embed_tokens"], axis=tp_axis, reduce="none"
-            )
-            x = reduce_scatter_sequence(partial.astype(cdt), tp_axis)
-        else:
-            x = vocab_parallel_embedding(
-                input_ids, params["embed_tokens"], axis=tp_axis
-            ).astype(cdt)
+    x = embed(params, input_ids, cfg, tp_axis=tp_axis,
+              sequence_parallel=sequence_parallel)
 
     # RoPE tables computed once and shared across layers (reference
     # llama.py:476-491), fp32 then cast at application.
@@ -302,30 +370,13 @@ def forward(
                            positions=positions)
 
     attn_fn = get_attention_backend(attention_backend)
-
-    def layer_body(h, layer_params):
-        h = _decoder_layer(
-            h, layer_params, cos, sin, cfg, attn_fn,
-            tp_axis=tp_axis, sequence_parallel=sequence_parallel,
-        )
-        return h, None
-
-    if gradient_checkpointing:
-        layer_body = jax.checkpoint(
-            layer_body, policy=jax.checkpoint_policies.nothing_saveable
-        )
-
-    x, _ = jax.lax.scan(layer_body, x, params["layers"])
-
-    x = rms_norm(
-        x,
-        pvary_missing(params["norm"], tp_axis) if tp_axis else params["norm"],
-        cfg.rms_norm_eps,
+    x = decoder_stack(
+        x, params["layers"], cos, sin, cfg, attn_fn,
+        tp_axis=tp_axis, sequence_parallel=sequence_parallel,
+        gradient_checkpointing=gradient_checkpointing,
     )
-    if sequence_parallel:
-        from scaletorch_tpu.parallel.sequence_parallel import all_gather_sequence
-
-        x = all_gather_sequence(x, tp_axis)
+    x = final_hidden(params, x, cfg, tp_axis=tp_axis,
+                     sequence_parallel=sequence_parallel)
     if return_hidden:
         # Caller applies the LM head via lm_head_weight() (e.g. the fused
         # chunked CE in parallel/spmd.py).

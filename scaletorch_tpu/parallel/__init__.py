@@ -7,3 +7,9 @@ from scaletorch_tpu.parallel.mesh import (  # noqa: F401
     setup_mesh_manager,
     reset_mesh_manager,
 )
+from scaletorch_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    make_llama_pipeline_loss,
+    pipeline_spmd_loss,
+    stage_layer_partition,
+    validate_pp_divisibility,
+)

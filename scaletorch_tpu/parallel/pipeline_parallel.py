@@ -1,0 +1,255 @@
+"""Pipeline parallelism: SPMD collective-permute pipelining over the pp axis.
+
+Capability parity with reference scaletorch/parallel/pipeline_parallel/
+(pipeline_parallel.py:30-671 stage module + AFAB/1F1B schedules,
+pp_comms.py:86-286 blocking P2P), re-designed TPU-first:
+
+  * The reference is MPMD: each rank materialises only its stage's layers
+    and drives an eager fwd/bwd interleaving with blocking
+    ``torch_dist.send/recv``. On TPU the idiomatic shape is **SPMD
+    collective-permute pipelining** (the GSPMD/scaling-book recipe): the
+    stacked layer params are sharded on their leading (layer) axis over the
+    ``pp`` mesh axis, every device runs the same tick loop, and activations
+    advance one stage per tick via ``lax.ppermute`` — XLA lowers this to a
+    neighbour-to-neighbour ICI transfer that overlaps with the stage
+    compute of the *next* tick.
+  * A microbatch pipeline over M microbatches runs T = M + pp - 1 ticks
+    (the classic pipeline bubble). In ticks where a stage has no real work
+    it computes on zeros — wall-clock-equivalent to sitting in the bubble,
+    so SPMD wastes nothing the schedule didn't already waste.
+  * The backward schedule falls out of autodiff: the VJP of ``ppermute``
+    is the reverse ``ppermute``, so differentiating the tick loop yields
+    the mirrored backward pipeline (the reference hand-writes this
+    interleaving in train_step_pipeline_afab/1f1b).
+  * Schedules: ``afab`` differentiates one pipeline over all M microbatches
+    (activation memory O(M) stage-boundary carries — ticks are
+    rematerialised, so only the [B,S,H] carry per tick is stored, matching
+    AFAB's per-microbatch boundary storage). ``1f1b`` chunks microbatches
+    into groups of pp and accumulates grads chunk-by-chunk, bounding
+    in-flight activations at O(pp) exactly like 1F1B's steady state
+    (reference warmup = pp - rank - 1, pipeline_parallel.py:457-671); the
+    price is a bubble per chunk rather than per step.
+
+``stage_layer_partition`` keeps the reference's uneven-layer bookkeeping
+(pipeline_parallel.py:83-133) for checkpoint naming and HF-weight loading;
+the SPMD compute path requires num_layers % pp == 0 (stacked-scan layout).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from scaletorch_tpu.parallel.mesh import MeshManager
+from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+
+
+def stage_layer_partition(
+    num_layers: int,
+    pp_size: int,
+    custom_distribution: Optional[Sequence[int]] = None,
+) -> List[List[int]]:
+    """Contiguous greedy layer split; remainder layers go to EARLY stages.
+
+    Parity with reference PipelineParallel.distribute_layers
+    (pipeline_parallel.py:83-133): returns, per stage, the list of global
+    layer indices it owns. ``custom_distribution`` overrides the per-stage
+    counts (must sum to num_layers).
+    """
+    if num_layers < pp_size:
+        raise ValueError(
+            f"num_layers={num_layers} < pp_size={pp_size}: every stage needs a layer"
+        )
+    if custom_distribution is not None:
+        counts = list(custom_distribution)
+        if len(counts) != pp_size:
+            raise ValueError(
+                f"custom_distribution has {len(counts)} entries, expected {pp_size}"
+            )
+        if any(c < 1 for c in counts):
+            raise ValueError("every stage must get >= 1 layer")
+        if sum(counts) != num_layers:
+            raise ValueError(
+                f"custom_distribution sums to {sum(counts)}, expected {num_layers}"
+            )
+    else:
+        base, rem = divmod(num_layers, pp_size)
+        counts = [base + (1 if s < rem else 0) for s in range(pp_size)]
+    out, start = [], 0
+    for c in counts:
+        out.append(list(range(start, start + c)))
+        start += c
+    return out
+
+
+def validate_pp_divisibility(cfg, pp: int) -> None:
+    """The SPMD stacked-layer layout shards the layer axis evenly over pp."""
+    if cfg.num_hidden_layers % pp != 0:
+        raise ValueError(
+            f"num_hidden_layers={cfg.num_hidden_layers} not divisible by pp={pp} "
+            "(SPMD pipeline shards the stacked layer axis; use a layer count "
+            "divisible by pp, or pad with identity layers)"
+        )
+
+
+def pipeline_spmd_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    model_cfg,
+    *,
+    pp_size: int,
+    embed_fn: Callable,
+    stage_fn: Callable,
+    loss_fn: Callable,
+    pp_axis: str = "pp",
+    all_axes: Sequence[str] = ("dp", "cp", "tp", "pp"),
+    remat_ticks: bool = True,
+    carry_seq_divisor: int = 1,
+) -> jax.Array:
+    """Mean loss over M microbatches through the pp-stage pipeline.
+
+    Must run inside a shard_map over a mesh containing ``pp_axis``, with
+    the stacked layer params sharded on their leading axis over pp (and
+    everything else — embed/norm/head — replicated over pp).
+
+    batch leaves: input_ids/target_ids [M, B, S], position_ids [M, S]
+    (S already CP-sharded when cp > 1).
+
+    ``embed_fn(params, ids) -> x``        first-stage entry ([B, S', H])
+    ``stage_fn(params, x, pos) -> x``     this stage's layer stack
+    ``loss_fn(params, x, targets) -> l``  last-stage epilogue (norm+head+CE)
+
+    Numerical-safety invariant: ticks outside a stage's live window and
+    non-last-stage loss inputs are zeros, never garbage, so no NaN/Inf can
+    leak into the psum'd loss or its cotangents.
+    """
+    ids, tgt, pos = batch["input_ids"], batch["target_ids"], batch["position_ids"]
+    m, b, s = ids.shape
+    pad = pp_size - 1
+    axes = tuple(all_axes)
+    # Stage predicates, pre-varied over every axis so jnp.where operands
+    # always agree on vma (shard_map's varying-axis bookkeeping).
+    stage = pvary_missing(jax.lax.axis_index(pp_axis), axes)
+    is_first = stage == 0
+    is_last = stage == pp_size - 1
+
+    # Carry shape = the embed output, computed statically (no abstract eval
+    # of collectives inside the traced region).
+    s_local = s // carry_seq_divisor
+    carry_shape = (b, s_local, model_cfg.hidden_size)
+
+    ids_p = jnp.concatenate([ids, jnp.zeros((pad, b, s), ids.dtype)], axis=0)
+    pos_p = jnp.concatenate([pos, jnp.zeros((pad, s), pos.dtype)], axis=0)
+    ids_p = pvary_missing(ids_p, axes)
+    pos_p = pvary_missing(pos_p, axes)
+
+    fwd_pairs = [(i, i + 1) for i in range(pp_size - 1)]
+
+    def tick(carry, xs):
+        x, pos = carry
+        ids_t, pos_t = xs
+        if pp_size > 1:
+            # Stage s hands its activation (and the microbatch's positions,
+            # which RoPE needs at EVERY stage — stage s is processing
+            # microbatch t - s, not t) to s+1; stage 0 receives zeros (no
+            # source), the last stage's outgoing value is dropped.
+            x, pos = jax.lax.ppermute((x, pos), pp_axis, fwd_pairs)
+        emb = pvary_missing(embed_fn(params, ids_t), axes)
+        x = jnp.where(is_first, emb, x)
+        pos = jnp.where(is_first, pos_t, pos)
+        x = stage_fn(params, x, pos)
+        # Re-vary to the full axis set: stage_fn's trailing psum (row-
+        # parallel all-reduce) drops 'tp' from the vma; the carry must have
+        # a fixed vma across scan iterations. The pvary transpose is the
+        # per-layer f-function backward all-reduce the reference also pays
+        # (tp_comms.py:64-114).
+        return (pvary_missing(x, axes), pos), x
+
+    if remat_ticks:
+        tick = jax.checkpoint(tick)
+
+    x0 = pvary_missing(jnp.zeros(carry_shape, model_cfg.dtype), axes)
+    pos0 = pvary_missing(jnp.zeros((s,), pos.dtype), axes)
+    _, ys = jax.lax.scan(tick, (x0, pos0), (ids_p, pos_p))
+    outs = ys[pad:]  # [M, B, S', H]; meaningful only on the last stage
+
+    # Zero-sanitise before the head so non-last stages compute a finite
+    # (discarded) loss — 0 * Inf = NaN in the masked-out cotangent path is
+    # the failure mode this avoids.
+    outs = pvary_missing(outs, axes)
+    outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
+
+    def mb_loss(acc, xm_tm):
+        x_m, t_m = xm_tm
+        return acc + pvary_missing(loss_fn(params, x_m, t_m), axes), None
+
+    zero = pvary_missing(jnp.float32(0.0), axes)
+    tgt_v = pvary_missing(tgt, axes)
+    loss_sum, _ = jax.lax.scan(mb_loss, zero, (outs, tgt_v))
+    loss = loss_sum / m
+    # Only the last stage computed a real loss; broadcast it to all stages
+    # (every rank needs the same cotangent seed for its local params).
+    return jax.lax.psum(jnp.where(is_last, loss, jnp.zeros_like(loss)), pp_axis)
+
+
+def make_llama_pipeline_loss(
+    mm: MeshManager,
+    model_cfg,
+    *,
+    attention_backend: str = "sdpa",
+    gradient_checkpointing: bool = False,
+    sequence_parallel: bool = False,
+    tp_axis: Optional[str] = "tp",
+    pp_axis: str = "pp",
+    head_weight_fn: Optional[Callable] = None,
+) -> Callable:
+    """Bind the Llama/Qwen3 model pieces into a pipeline loss callable
+    ``(params, batch) -> loss`` for use inside the 5D shard_map."""
+    from scaletorch_tpu.models import llama
+    from scaletorch_tpu.models.layers import get_cos_sin
+    from scaletorch_tpu.models.registry import get_attention_backend
+    from scaletorch_tpu.parallel.tensor_parallel import (
+        fused_vocab_parallel_cross_entropy,
+    )
+
+    validate_pp_divisibility(model_cfg, mm.pp)
+    attn_fn = get_attention_backend(attention_backend)
+    if head_weight_fn is None:
+        head_weight_fn = llama.lm_head_weight
+    tp = tp_axis if mm.tp > 1 else None
+    sp = sequence_parallel and mm.tp > 1
+
+    def embed_fn(params, ids_t):
+        return llama.embed(params, ids_t, model_cfg, tp_axis=tp,
+                           sequence_parallel=sp)
+
+    def stage_fn(params, x, pos_t):
+        cos, sin = get_cos_sin(
+            pos_t.shape[0], model_cfg.actual_head_dim, model_cfg.rope_theta,
+            positions=pos_t,
+        )
+        # params["layers"] leaves arrive pp-sharded: leading dim = L / pp,
+        # i.e. exactly this stage's contiguous layer block.
+        return llama.decoder_stack(
+            x, params["layers"], cos, sin, model_cfg, attn_fn,
+            tp_axis=tp, sequence_parallel=sp,
+            gradient_checkpointing=gradient_checkpointing,
+        )
+
+    def loss_fn(params, x_m, t_m):
+        x_m = llama.final_hidden(params, x_m, model_cfg, tp_axis=tp,
+                                 sequence_parallel=sp)
+        head = head_weight_fn(params, model_cfg, tp)
+        return fused_vocab_parallel_cross_entropy(x_m, head, t_m, axis=tp)
+
+    def pipeline_loss(params, batch):
+        return pipeline_spmd_loss(
+            params, batch, model_cfg,
+            pp_size=mm.pp, embed_fn=embed_fn, stage_fn=stage_fn,
+            loss_fn=loss_fn, pp_axis=pp_axis,
+            carry_seq_divisor=mm.tp if sp else 1,
+        )
+
+    return pipeline_loss

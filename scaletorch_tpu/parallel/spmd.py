@@ -27,7 +27,7 @@ expert_parallel) — the spmd step accepts a stage-local forward for PP.
 from __future__ import annotations
 
 
-from typing import Any, Callable, Dict, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -54,27 +54,37 @@ def opt_state_specs(tx: optax.GradientTransformation, params: Any, param_specs: 
     )
 
 
-def _leaf_sqsum_partitioned(grads: Any, tp_axis: str) -> jax.Array:
+def _leaf_sqsum_partitioned(
+    grads: Any, shard_axes: Tuple[str, ...] = ("tp", "pp")
+) -> jax.Array:
     """Global sum of squares over a gradient tree whose leaves are a mix of
-    tp-sharded (varying over tp) and replicated (unvarying) arrays."""
-    local_sharded = jnp.float32(0.0)
-    replicated = jnp.float32(0.0)
+    model-sharded (varying over tp and/or pp) and replicated arrays.
+    Each leaf's partial square-sum is psum'd over exactly the shard axes it
+    varies over, so every element is counted once."""
+    groups: Dict[Tuple[str, ...], jax.Array] = {}
     for g in jax.tree_util.tree_leaves(grads):
         s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        if tp_axis in getattr(jax.typeof(g), "vma", ()):
-            local_sharded = local_sharded + s
-        else:
-            replicated = replicated + s
-    return jax.lax.psum(local_sharded, tp_axis) + replicated
+        axes = tuple(a for a in shard_axes if a in getattr(jax.typeof(g), "vma", ()))
+        groups[axes] = groups.get(axes, jnp.float32(0.0)) + s
+    total = jnp.float32(0.0)
+    for axes, s in groups.items():
+        total = total + (jax.lax.psum(s, axes) if axes else s)
+    return total
 
 
-def global_grad_norm(grads: Any, tp_axis: str = "tp") -> jax.Array:
-    return jnp.sqrt(_leaf_sqsum_partitioned(grads, tp_axis))
+def global_grad_norm(grads: Any, shard_axes: Tuple[str, ...] = ("tp", "pp")):
+    if isinstance(shard_axes, str):  # tolerate single-axis callers
+        shard_axes = (shard_axes,)
+    return jnp.sqrt(_leaf_sqsum_partitioned(grads, shard_axes))
 
 
-def clip_by_global_norm(grads: Any, max_norm: float, tp_axis: str = "tp"):
+def clip_by_global_norm(
+    grads: Any, max_norm: float, shard_axes: Tuple[str, ...] = ("tp", "pp")
+):
     """Returns (clipped_grads, pre_clip_norm)."""
-    norm = global_grad_norm(grads, tp_axis)
+    if isinstance(shard_axes, str):
+        shard_axes = (shard_axes,)
+    norm = global_grad_norm(grads, shard_axes)
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return jax.tree.map(lambda g: g * scale, grads), norm
 
@@ -103,6 +113,7 @@ def make_spmd_train_step(
     donate: bool = True,
     head_weight_fn: Optional[Callable] = None,
     param_specs: Any = None,
+    pp_schedule: str = "1f1b",
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -119,11 +130,19 @@ def make_spmd_train_step(
     model_cfg, tp_axis)`` must return the [H, V/tp] head weight — defaults
     to the Llama/Qwen3 accessors; pass both (plus ``param_specs``) for
     other model families.
+
+    With ``mm.pp > 1`` the microbatch loop becomes the SPMD
+    collective-permute pipeline (parallel/pipeline_parallel.py);
+    ``pp_schedule`` selects 'afab' or '1f1b' (reference pp_engine,
+    config.py:155-173) — the accum dim of the batch is the microbatch dim.
     """
+    use_pp = mm.pp > 1
     p_specs = (
         param_specs
         if param_specs is not None
-        else llama_param_specs(model_cfg, tp_axis="tp")
+        else llama_param_specs(
+            model_cfg, tp_axis="tp", pp_axis="pp" if use_pp else None
+        )
     )
     o_specs = opt_state_specs(tx, params, p_specs)
     b_specs = batch_specs()
@@ -150,62 +169,128 @@ def make_spmd_train_step(
             hidden, head, mb["target_ids"], axis="tp"
         )
 
-    all_axes = DATA_AXES + ("tp",)
+    all_axes = DATA_AXES + (("tp", "pp") if use_pp else ("tp",))
+
+    if use_pp:
+        if pp_schedule not in ("afab", "1f1b"):
+            raise ValueError(f"pp_schedule must be 'afab' or '1f1b', got {pp_schedule}")
+        if param_specs is not None:
+            # The PP path composes the Llama/Qwen3 pipeline pieces (embed /
+            # decoder_stack / final_hidden) over the pp-sharded stacked
+            # layer axis; a custom params tree would be silently trained
+            # against the wrong computation.
+            raise NotImplementedError(
+                "pp > 1 currently supports the built-in Llama/Qwen3 family "
+                "only (custom param_specs/model_forward not yet wired into "
+                "the pipeline schedule)"
+            )
+        from scaletorch_tpu.parallel.pipeline_parallel import make_llama_pipeline_loss
+
+        pipe_loss = make_llama_pipeline_loss(
+            mm, model_cfg,
+            attention_backend=attention_backend,
+            gradient_checkpointing=gradient_checkpointing,
+            sequence_parallel=sequence_parallel,
+            head_weight_fn=head_weight_fn,
+        )
 
     def step(p, opt_state, batch):
         accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
 
-        # Broadcast every leaf to varying over (dp, cp, tp) BEFORE the
-        # microbatch loop. Differentiating w.r.t. these pre-varied params
-        # keeps every backward collective-free (the broadcast's psum
+        # Broadcast every leaf to varying over (dp, cp, tp[, pp]) BEFORE
+        # the microbatch loop. Differentiating w.r.t. these pre-varied
+        # params keeps every backward collective-free (the broadcast's psum
         # transpose would otherwise fire per microbatch), so accumulation
         # is purely local and the reduction below runs ONCE per step —
         # the no_sync + single-bucket-flush contract
         # (reference data_parallel.py:46-68, bucket.py:58-77).
-        replicated_over_tp = [
-            "tp" not in getattr(jax.typeof(x), "vma", ())
+        vma_of = lambda x: getattr(jax.typeof(x), "vma", ())  # noqa: E731
+        shard_axes = ("tp", "pp") if use_pp else ("tp",)
+        # Per leaf: the model axes it is NOT sharded over — its gradient
+        # shards are partial sums needing a psum over exactly those axes.
+        rep_axes = [
+            tuple(a for a in shard_axes if a not in vma_of(x))
             for x in jax.tree_util.tree_leaves(p)
         ]
         from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
         p_v = jax.tree.map(lambda x: pvary_missing(x, all_axes), p)
 
-        def micro_step(carry, mb):
-            g_acc, l_acc = carry
-            loss, grads = jax.value_and_grad(loss_fn)(p_v, mb)
-            return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
-
         zeros = jax.tree.map(
             lambda x: jax.lax.pvary(
                 jnp.zeros(x.shape, jnp.float32),
-                tuple(getattr(jax.typeof(x), "vma", ())),
+                tuple(vma_of(x)),
             ),
             p_v,
         )
-        (grads, loss_sum), _ = jax.lax.scan(
-            micro_step, (zeros, jax.lax.pvary(jnp.float32(0.0), all_axes)), batch
-        )
-        grads = jax.tree.map(lambda g: g / accum, grads)
-        loss = loss_sum / accum
+
+        if use_pp and pp_schedule == "afab":
+            # One pipeline over all microbatches; autodiff yields the
+            # mirrored backward pipeline (all-forward-all-backward).
+            loss, grads = jax.value_and_grad(pipe_loss)(p_v, batch)
+            loss = pvary_missing(loss, all_axes)
+        elif use_pp:
+            # 1F1B-equivalent memory: chunk microbatches into groups of pp
+            # and accumulate grads chunk-by-chunk, bounding in-flight
+            # activations at O(pp) like the reference's steady state
+            # (pipeline_parallel.py:457-671).
+            chunk = mm.pp
+            if accum % chunk != 0:
+                raise ValueError(
+                    f"1f1b schedule needs grad_accum ({accum}) divisible by pp "
+                    f"({chunk}); use afab or adjust grad_accum"
+                )
+            nchunks = accum // chunk
+            batch_c = jax.tree.map(
+                lambda x: x.reshape((nchunks, chunk) + x.shape[1:]), batch
+            )
+
+            def chunk_step(carry, mb):
+                g_acc, l_acc = carry
+                loss, grads = jax.value_and_grad(pipe_loss)(p_v, mb)
+                loss = pvary_missing(loss, all_axes)
+                return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+
+            (grads, loss_sum), _ = jax.lax.scan(
+                chunk_step,
+                (zeros, jax.lax.pvary(jnp.float32(0.0), all_axes)),
+                batch_c,
+            )
+            grads = jax.tree.map(lambda g: g / nchunks, grads)
+            loss = loss_sum / nchunks
+        else:
+
+            def micro_step(carry, mb):
+                g_acc, l_acc = carry
+                loss, grads = jax.value_and_grad(loss_fn)(p_v, mb)
+                return (jax.tree.map(jnp.add, g_acc, grads), l_acc + loss), None
+
+            (grads, loss_sum), _ = jax.lax.scan(
+                micro_step, (zeros, jax.lax.pvary(jnp.float32(0.0), all_axes)), batch
+            )
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            loss = loss_sum / accum
 
         # THE gradient reduction: mean over the fused data group (cp_dp_group
-        # parity), plus a sum over tp for tp-replicated leaves whose shards
-        # each contributed a partial gradient (the reference g-function
-        # all-reduce, folded into the same single reduction point).
+        # parity), plus a sum over tp/pp for model-replicated leaves whose
+        # shards each contributed a partial gradient (the reference
+        # g-function all-reduce, folded into the same single reduction
+        # point; pp-replicated leaves — embed/norm/head — are psum'd over
+        # pp because only their owning stage produced a nonzero grad).
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         reduced = []
-        for g, rep_tp in zip(leaves, replicated_over_tp):
+        for g, axes in zip(leaves, rep_axes):
             g = jax.lax.pmean(g, DATA_AXES)
-            if rep_tp:
-                g = jax.lax.psum(g, "tp")
+            if axes:
+                g = jax.lax.psum(g, axes)
             reduced.append(g)
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
         loss = jax.lax.pmean(loss, all_axes)
 
         if max_grad_norm and max_grad_norm > 0:
-            grads, grad_norm = clip_by_global_norm(grads, max_grad_norm, "tp")
+            grads, grad_norm = clip_by_global_norm(grads, max_grad_norm, shard_axes)
         else:
-            grad_norm = global_grad_norm(grads, "tp")
+            grad_norm = global_grad_norm(grads, shard_axes)
 
         updates, opt_state = tx.update(grads, opt_state, p)
         p = optax.apply_updates(p, updates)

@@ -259,7 +259,9 @@ def validate_tp_divisibility(cfg, tp: int) -> None:
             raise ValueError(f"{name}={value} not divisible by tp={tp}")
 
 
-def llama_param_specs(cfg, *, tp_axis: Optional[str] = "tp") -> dict:
+def llama_param_specs(
+    cfg, *, tp_axis: Optional[str] = "tp", pp_axis: Optional[str] = None
+) -> dict:
     """PartitionSpec pytree for Llama/Qwen3 params — the declarative
     equivalent of the reference's module-replacement map
     (tensor_parallel.py:25,107-143):
@@ -267,22 +269,27 @@ def llama_param_specs(cfg, *, tp_axis: Optional[str] = "tp") -> dict:
       o/down        -> row (input dim over tp)
       embedding     -> vocab rows over tp; lm_head -> vocab cols over tp
       norms         -> replicated
+
+    With ``pp_axis``, the stacked layer axis (leading dim of every layers
+    leaf) is sharded over pp — the SPMD equivalent of the reference's
+    per-stage layer ownership (pipeline_parallel.py:83-178); embed/norm/
+    head stay replicated over pp (stage gating happens in the schedule).
     """
-    t = tp_axis
+    t, pstg = tp_axis, pp_axis
     layers = {
-        "input_layernorm": P(None, None),
-        "q_proj": P(None, None, t),
-        "k_proj": P(None, None, t),
-        "v_proj": P(None, None, t),
-        "o_proj": P(None, t, None),
-        "post_attention_layernorm": P(None, None),
-        "gate_proj": P(None, None, t),
-        "up_proj": P(None, None, t),
-        "down_proj": P(None, t, None),
+        "input_layernorm": P(pstg, None),
+        "q_proj": P(pstg, None, t),
+        "k_proj": P(pstg, None, t),
+        "v_proj": P(pstg, None, t),
+        "o_proj": P(pstg, t, None),
+        "post_attention_layernorm": P(pstg, None),
+        "gate_proj": P(pstg, None, t),
+        "up_proj": P(pstg, None, t),
+        "down_proj": P(pstg, t, None),
     }
     if cfg.qk_norm:
-        layers["q_norm"] = P(None, None)
-        layers["k_norm"] = P(None, None)
+        layers["q_norm"] = P(pstg, None)
+        layers["k_norm"] = P(pstg, None)
     specs = {
         "embed_tokens": P(t, None),
         "layers": layers,

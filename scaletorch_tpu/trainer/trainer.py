@@ -142,6 +142,7 @@ class Trainer:
             sequence_parallel=cfg.sequence_parallel,
             max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
+            pp_schedule=cfg.pp_engine,
         )
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)

@@ -1,0 +1,211 @@
+"""Pipeline parallelism: partition math parity + golden numerics.
+
+The reference tests layer distribution and schedule bookkeeping against a
+mocked pgm (tests/parallel/test_pipeline_parallel.py); here the partition
+math is tested pure and the full SPMD collective-permute pipeline runs on
+the 8-virtual-device mesh, checked against the single-device forward/
+backward — loss AND gradients must match to fp32 tolerance.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from scaletorch_tpu.models.layers import cross_entropy_loss
+from scaletorch_tpu.models.llama import LlamaConfig, forward, init_params
+from scaletorch_tpu.parallel.mesh import MeshManager
+from scaletorch_tpu.parallel.pipeline_parallel import (
+    make_llama_pipeline_loss,
+    stage_layer_partition,
+    validate_pp_divisibility,
+)
+
+CFG = LlamaConfig(
+    vocab_size=128, hidden_size=32, intermediate_size=64, num_hidden_layers=4,
+    num_attention_heads=4, num_key_value_heads=4, dtype=jnp.float32,
+)
+
+
+class TestStagePartition:
+    def test_even_split(self):
+        assert stage_layer_partition(8, 4) == [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+    def test_remainder_to_early_stages(self):
+        # parity: reference distribute_layers, pipeline_parallel.py:83-133
+        assert stage_layer_partition(10, 4) == [
+            [0, 1, 2], [3, 4, 5], [6, 7], [8, 9]
+        ]
+
+    def test_custom_distribution(self):
+        assert stage_layer_partition(6, 3, [1, 2, 3]) == [[0], [1, 2], [3, 4, 5]]
+
+    def test_custom_distribution_errors(self):
+        with pytest.raises(ValueError, match="sums to"):
+            stage_layer_partition(6, 3, [1, 2, 2])
+        with pytest.raises(ValueError, match="entries"):
+            stage_layer_partition(6, 3, [3, 3])
+        with pytest.raises(ValueError, match=">= 1"):
+            stage_layer_partition(6, 3, [0, 3, 3])
+
+    def test_more_stages_than_layers(self):
+        with pytest.raises(ValueError, match="every stage needs"):
+            stage_layer_partition(2, 4)
+
+    def test_validate_divisibility(self):
+        validate_pp_divisibility(CFG, 2)
+        with pytest.raises(ValueError, match="not divisible"):
+            validate_pp_divisibility(CFG, 3)
+
+
+def _golden(params, ids, targets):
+    """Single-device loss + grads: mean over microbatches of per-mb CE
+    (same fused-CE token math as the pipeline path, so tolerances stay at
+    fp32 roundoff rather than accumulation-order noise)."""
+    from scaletorch_tpu.models.llama import lm_head_weight
+    from scaletorch_tpu.parallel.tensor_parallel import (
+        fused_vocab_parallel_cross_entropy,
+    )
+
+    def loss_fn(p):
+        losses = []
+        for i in range(ids.shape[0]):
+            hidden = forward(p, ids[i], CFG, return_hidden=True)
+            losses.append(fused_vocab_parallel_cross_entropy(
+                hidden, lm_head_weight(p, CFG), targets[i], axis=None
+            ))
+        return jnp.mean(jnp.stack(losses))
+
+    return jax.value_and_grad(loss_fn)(params)
+
+
+def _pipeline(mm, params, ids, targets, **kw):
+    from scaletorch_tpu.parallel.tensor_parallel import llama_param_specs
+
+    pipe_loss = make_llama_pipeline_loss(mm, CFG, **kw)
+    p_specs = llama_param_specs(
+        CFG, tp_axis="tp" if mm.tp > 1 else None, pp_axis="pp"
+    )
+    b_specs = {
+        "input_ids": P(None, "dp", "cp" if mm.cp > 1 else None),
+        "target_ids": P(None, "dp", "cp" if mm.cp > 1 else None),
+        "position_ids": P(None, "cp" if mm.cp > 1 else None),
+    }
+    m, _, s = ids.shape
+    batch = {
+        "input_ids": ids,
+        "target_ids": targets,
+        "position_ids": np.broadcast_to(
+            np.arange(s, dtype=np.int32), (m, s)
+        ).copy(),
+    }
+    from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+
+    def mean_loss(p, b):
+        axes = ("dp", "cp", "tp", "pp")
+        return jax.lax.pmean(pvary_missing(pipe_loss(p, b), axes), axes)
+
+    f = jax.jit(
+        jax.value_and_grad(
+            jax.shard_map(
+                mean_loss, mesh=mm.mesh,
+                in_specs=(p_specs, b_specs), out_specs=P(),
+            )
+        )
+    )
+    return f(params, batch)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = init_params(jax.random.PRNGKey(0), CFG)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 4, 16), 0, CFG.vocab_size)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (4, 4, 16), 0, CFG.vocab_size)
+    loss, grads = _golden(params, ids, targets)
+    return params, ids, targets, loss, grads
+
+
+class TestPipelineNumerics:
+    @pytest.mark.parametrize("pp", [2, 4])
+    def test_pp_matches_single_device(self, setup, pp):
+        params, ids, targets, ref_loss, ref_grads = setup
+        mm = MeshManager(pp=pp, dp=8 // pp)
+        loss, grads = _pipeline(mm, params, ids, targets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        # pipeline grads are per-parameter partials; only loss grads w.r.t.
+        # full params compare (specs gather shards back automatically
+        # outside shard_map)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=2e-5),
+            grads, ref_grads,
+        )
+
+    def test_pp_with_tp(self, setup):
+        params, ids, targets, ref_loss, ref_grads = setup
+        mm = MeshManager(pp=2, tp=2, dp=2)
+        loss, grads = _pipeline(mm, params, ids, targets)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=2e-5),
+            grads, ref_grads,
+        )
+
+    def test_pp_with_tp_sp(self, setup):
+        params, ids, targets, ref_loss, ref_grads = setup
+        mm = MeshManager(pp=2, tp=2, dp=2)
+        loss, grads = _pipeline(
+            mm, params, ids, targets, sequence_parallel=True
+        )
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=5e-4, atol=2e-5),
+            grads, ref_grads,
+        )
+
+    def test_pp_gradient_checkpointing(self, setup):
+        params, ids, targets, ref_loss, _ = setup
+        mm = MeshManager(pp=2, dp=4)
+        loss, _ = _pipeline(mm, params, ids, targets, gradient_checkpointing=True)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
+
+
+class TestPipelineTrainStep:
+    @pytest.mark.parametrize("schedule", ["afab", "1f1b"])
+    def test_spmd_step_with_pp(self, schedule):
+        from scaletorch_tpu.config import ScaleTorchTPUArguments
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
+        from scaletorch_tpu.trainer.optimizer import create_optimizer
+
+        mm = MeshManager(pp=2, tp=2, dp=2)
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        tcfg = ScaleTorchTPUArguments(
+            learning_rate=1e-3, total_train_steps=10, warmup_steps=0
+        )
+        tx, _ = create_optimizer(tcfg, include_clip=False)
+        step_fn, p_specs, o_specs = make_spmd_train_step(
+            mm, forward, CFG, tx, params,
+            max_grad_norm=1.0, pp_schedule=schedule, donate=False,
+        )
+        params_s = shard_params(mm, params, p_specs)
+        opt_state = shard_params(mm, tx.init(params), o_specs)
+
+        rng = np.random.default_rng(0)
+        accum, bsz, seq = 2, 2, 16
+        ids = rng.integers(0, CFG.vocab_size, (accum, bsz, seq + 1))
+        batch = {
+            "input_ids": ids[:, :, :-1].astype(np.int32),
+            "target_ids": ids[:, :, 1:].astype(np.int32),
+            "position_ids": np.broadcast_to(
+                np.arange(seq, dtype=np.int32), (accum, seq)
+            ).copy(),
+        }
+        p2, o2, metrics = step_fn(params_s, opt_state, batch)
+        assert np.isfinite(float(metrics["loss"]))
+        assert np.isfinite(float(metrics["grad_norm"]))
+        # params actually changed (compare against the host copy —
+        # params_s was donated into the step)
+        delta = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(jnp.asarray(a) - b))), p2, params
+        )
+        assert max(jax.tree.leaves(delta)) > 0
