@@ -207,8 +207,13 @@ class ScaleTorchTPUArguments(
                     f"sequence_parallel needs per-cp-rank sequence {seq_local} "
                     f"divisible by tensor_parallel_size {self.tensor_parallel_size}"
                 )
+        # ep shards the batch too (each ep rank gets distinct tokens and
+        # exchanges them by expert ownership — unlike the reference, which
+        # replicates data across ep ranks, dataloader.py:170-186), so the
+        # effective data-parallel width is dp * ep.
         expected_gbs = (
             self.data_parallel_size
+            * self.expert_parallel_size
             * self.micro_batch_size
             * self.gradient_accumulation_steps
         )
@@ -216,8 +221,8 @@ class ScaleTorchTPUArguments(
             self.global_batch_size = expected_gbs
         elif self.global_batch_size != expected_gbs:
             raise ValueError(
-                f"global_batch_size {self.global_batch_size} != dp * micro_bs * "
-                f"grad_accum = {expected_gbs}"
+                f"global_batch_size {self.global_batch_size} != dp * ep * "
+                f"micro_bs * grad_accum = {expected_gbs}"
             )
         if self.num_microbatches is None:
             self.num_microbatches = self.gradient_accumulation_steps

@@ -116,9 +116,13 @@ class LlamaConfig:
         return l * per_layer + embed + h + head
 
 
-def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
+def init_params(key: jax.Array, cfg: LlamaConfig, *, mlp: bool = True) -> Params:
     """Random init: fan-in uniform for projections (reference
-    attention_utils.py:160-167), ones for norms, normal(0.02) embeddings."""
+    attention_utils.py:160-167), ones for norms, normal(0.02) embeddings.
+
+    ``mlp=False`` skips the dense MLP stacks (MoE models replace them with
+    expert weights — no point materialising weights that are discarded).
+    """
     l = cfg.num_hidden_layers
     h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
     dh = cfg.actual_head_dim
@@ -137,10 +141,11 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
         "v_proj": stack_init(keys[2], (h, cfg.kv_size), h),
         "o_proj": stack_init(keys[3], (cfg.q_size, h), cfg.q_size),
         "post_attention_layernorm": jnp.ones((l, h), pd),
-        "gate_proj": stack_init(keys[4], (h, i), h),
-        "up_proj": stack_init(keys[5], (h, i), h),
-        "down_proj": stack_init(keys[6], (i, h), i),
     }
+    if mlp:
+        layers["gate_proj"] = stack_init(keys[4], (h, i), h)
+        layers["up_proj"] = stack_init(keys[5], (h, i), h)
+        layers["down_proj"] = stack_init(keys[6], (i, h), i)
     if cfg.qk_norm:
         layers["q_norm"] = jnp.ones((l, dh), pd)
         layers["k_norm"] = jnp.ones((l, dh), pd)
@@ -155,29 +160,14 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> Params:
     return params
 
 
-def _decoder_layer(
-    x: jax.Array,
-    layer: Params,
-    cos: jax.Array,
-    sin: jax.Array,
+def tp_region_helpers(
     cfg: LlamaConfig,
-    attn_fn: Callable,
-    tp_axis: Optional[str] = None,
-    sequence_parallel: bool = False,
-) -> jax.Array:
-    """One pre-norm decoder block. x: [B, S, H] in compute dtype.
-
-    With ``tp_axis`` set (inside shard_map, weights arriving pre-sharded
-    per llama_param_specs): q/k/v/gate/up are column-parallel, o/down are
-    row-parallel (reference apply_tensor_parallel mapping,
-    tensor_parallel.py:107-143). With ``sequence_parallel``, x is
-    seq-sharded over tp; norm regions run on the shard, attention/MLP on
-    the gathered sequence, and the row-parallel all-reduce becomes a
-    reduce-scatter (reference llama.py:314-377, sp_comms.py:31-94).
-    """
-    nh_l = layer["q_proj"].shape[-1]  # local q width (already tp-sliced)
-    nkv_l = layer["k_proj"].shape[-1]
-    dh = cfg.actual_head_dim
+    tp_axis: Optional[str],
+    sequence_parallel: bool,
+) -> Tuple[Callable, Callable, Callable, Callable]:
+    """(pv, enter_full_seq, col, row) — the four region functions that
+    parameterise a decoder block over its TP/SP mode. Shared by the dense
+    decoder layer and the MoE decoder layer."""
     cdt = cfg.dtype
     tp = tp_axis
 
@@ -217,7 +207,25 @@ def _decoder_layer(
         def row(h, w):
             return h @ w.astype(cdt)
 
-    # ---- attention ----------------------------------------------------------
+    return pv, enter_full_seq, col, row
+
+
+def attention_block(
+    x: jax.Array,
+    layer: Params,
+    cos: jax.Array,
+    sin: jax.Array,
+    cfg: LlamaConfig,
+    attn_fn: Callable,
+    helpers: Tuple[Callable, Callable, Callable, Callable],
+) -> jax.Array:
+    """Pre-norm attention sub-block with residual (reference
+    LlamaAttention, llama.py:132-198). Shared by dense and MoE layers."""
+    pv, enter_full_seq, col, row = helpers
+    nh_l = layer["q_proj"].shape[-1]  # local q width (already tp-sliced)
+    nkv_l = layer["k_proj"].shape[-1]
+    dh = cfg.actual_head_dim
+
     h = rms_norm(x, pv(layer["input_layernorm"]), cfg.rms_norm_eps)
     h = enter_full_seq(h)
     b, s, _ = h.shape
@@ -235,7 +243,33 @@ def _decoder_layer(
     q, k = apply_rotary_pos_emb(q, k, pv(cos), pv(sin))
     attn = attn_fn(q, k, v, causal=True)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh_l)
-    x = x + row(attn, layer["o_proj"])
+    return x + row(attn, layer["o_proj"])
+
+
+def _decoder_layer(
+    x: jax.Array,
+    layer: Params,
+    cos: jax.Array,
+    sin: jax.Array,
+    cfg: LlamaConfig,
+    attn_fn: Callable,
+    tp_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
+) -> jax.Array:
+    """One pre-norm decoder block. x: [B, S, H] in compute dtype.
+
+    With ``tp_axis`` set (inside shard_map, weights arriving pre-sharded
+    per llama_param_specs): q/k/v/gate/up are column-parallel, o/down are
+    row-parallel (reference apply_tensor_parallel mapping,
+    tensor_parallel.py:107-143). With ``sequence_parallel``, x is
+    seq-sharded over tp; norm regions run on the shard, attention/MLP on
+    the gathered sequence, and the row-parallel all-reduce becomes a
+    reduce-scatter (reference llama.py:314-377, sp_comms.py:31-94).
+    """
+    helpers = tp_region_helpers(cfg, tp_axis, sequence_parallel)
+    pv, enter_full_seq, col, row = helpers
+
+    x = attention_block(x, layer, cos, sin, cfg, attn_fn, helpers)
 
     # ---- SwiGLU MLP (reference llama.py:207-249) ----------------------------
     h = rms_norm(x, pv(layer["post_attention_layernorm"]), cfg.rms_norm_eps)

@@ -104,7 +104,7 @@ def pipeline_spmd_loss(
     stage_fn: Callable,
     loss_fn: Callable,
     pp_axis: str = "pp",
-    all_axes: Sequence[str] = ("dp", "cp", "tp", "pp"),
+    all_axes: Sequence[str] = ("dp", "cp", "ep", "tp", "pp"),
     remat_ticks: bool = True,
     carry_seq_divisor: int = 1,
 ) -> jax.Array:

@@ -90,11 +90,18 @@ def clip_by_global_norm(
 
 
 def batch_specs(with_cp: bool = True) -> Dict[str, P]:
-    """Sharding of the host-global step batch [accum, dp*micro, seq]."""
+    """Sharding of the host-global step batch [accum, dp*ep*micro, seq].
+
+    The batch dim shards over BOTH dp and ep: expert parallelism feeds
+    each ep rank distinct tokens and exchanges them by expert ownership
+    (the reference reads per-dp-rank data and all-to-alls over ep,
+    ep_comms.py:41-133 — here ep is simply one more data dim). With
+    ep == 1 this degenerates to pure dp sharding.
+    """
     seq_axis = "cp" if with_cp else None
     return {
-        "input_ids": P(None, "dp", seq_axis),
-        "target_ids": P(None, "dp", seq_axis),
+        "input_ids": P(None, ("dp", "ep"), seq_axis),
+        "target_ids": P(None, ("dp", "ep"), seq_axis),
         "position_ids": P(None, seq_axis),
     }
 
@@ -114,6 +121,7 @@ def make_spmd_train_step(
     head_weight_fn: Optional[Callable] = None,
     param_specs: Any = None,
     pp_schedule: str = "1f1b",
+    model_kwargs: Optional[Dict[str, Any]] = None,
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -151,7 +159,7 @@ def make_spmd_train_step(
         from scaletorch_tpu.models.llama import lm_head_weight as head_weight_fn
 
     def loss_fn(p, mb):
-        hidden = model_forward(
+        out = model_forward(
             p,
             mb["input_ids"],
             model_cfg,
@@ -161,17 +169,31 @@ def make_spmd_train_step(
             tp_axis="tp",
             sequence_parallel=sequence_parallel,
             return_hidden=True,
+            **(model_kwargs or {}),
         )
+        # MoE forwards return (hidden, scaled_aux_loss) — add the aux to
+        # the CE (reference train_step adds model.get_aux_loss()).
+        hidden, aux = out if isinstance(out, tuple) else (out, 0.0)
         # Head + CE fused over sequence chunks: full [B, S, V] logits never
         # materialise (vocab-parallel over tp AND chunk-rematerialised).
         head = head_weight_fn(p, model_cfg, "tp")
-        return fused_vocab_parallel_cross_entropy(
+        ce = fused_vocab_parallel_cross_entropy(
             hidden, head, mb["target_ids"], axis="tp"
         )
+        return ce + aux
 
-    all_axes = DATA_AXES + (("tp", "pp") if use_pp else ("tp",))
+    use_ep = mm.ep > 1
+    # 'ep' is always a data axis for the batch (batch_specs shards rows
+    # over ("dp","ep")), so it is always in the pvary set — even at ep=1
+    # the vma bookkeeping must line up.
+    all_axes = DATA_AXES + ("ep",) + (("tp", "pp") if use_pp else ("tp",))
 
     if use_pp:
+        if use_ep:
+            raise NotImplementedError(
+                "pp > 1 with ep > 1 is not yet supported (MoE models are "
+                "not wired into the pipeline schedule)"
+            )
         if pp_schedule not in ("afab", "1f1b"):
             raise ValueError(f"pp_schedule must be 'afab' or '1f1b', got {pp_schedule}")
         if param_specs is not None:
@@ -211,6 +233,12 @@ def make_spmd_train_step(
         rep_axes = [
             tuple(a for a in shard_axes if a not in vma_of(x))
             for x in jax.tree_util.tree_leaves(p)
+        ]
+        # Expert-sharded leaves (varying over ep): their backward
+        # all-to-all already summed every ep rank's loss contribution, so
+        # they take a 1/ep scale instead of the data-axis pmean over ep.
+        ep_sharded = [
+            "ep" in vma_of(x) for x in jax.tree_util.tree_leaves(p)
         ]
         from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
@@ -278,19 +306,24 @@ def make_spmd_train_step(
         # point; pp-replicated leaves — embed/norm/head — are psum'd over
         # pp because only their owning stage produced a nonzero grad).
         leaves, treedef = jax.tree_util.tree_flatten(grads)
+        data_axes_full = DATA_AXES + ("ep",)
         reduced = []
-        for g, axes in zip(leaves, rep_axes):
-            g = jax.lax.pmean(g, DATA_AXES)
+        for g, axes, is_ep in zip(leaves, rep_axes, ep_sharded):
+            if is_ep:
+                g = jax.lax.pmean(g, DATA_AXES) / mm.ep
+            else:
+                g = jax.lax.pmean(g, data_axes_full)
             if axes:
                 g = jax.lax.psum(g, axes)
             reduced.append(g)
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
         loss = jax.lax.pmean(loss, all_axes)
 
+        norm_axes = shard_axes + ("ep",)
         if max_grad_norm and max_grad_norm > 0:
-            grads, grad_norm = clip_by_global_norm(grads, max_grad_norm, shard_axes)
+            grads, grad_norm = clip_by_global_norm(grads, max_grad_norm, norm_axes)
         else:
-            grad_norm = global_grad_norm(grads, shard_axes)
+            grad_norm = global_grad_norm(grads, norm_axes)
 
         updates, opt_state = tx.update(grads, opt_state, p)
         p = optax.apply_updates(p, updates)

@@ -31,12 +31,20 @@ _DTYPE = {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float
 def build_model_config(cfg: ScaleTorchTPUArguments):
     """model_type dispatch (reference model_builder.py:68-74), with HF
     AutoConfig auto-fill when model_name_or_path is set."""
+    from scaletorch_tpu.models import qwen3_moe
+
     dtype = _DTYPE[cfg.dtype]
     overrides = dict(dtype=dtype)
     if cfg.model_name_or_path:
         from transformers import AutoConfig
 
         hf = AutoConfig.from_pretrained(cfg.model_name_or_path)
+        if cfg.model_type == "qwen3_moe":
+            # training knobs (capacity etc.) are not in HF configs — thread
+            # the CLI values through alongside the architecture fields
+            return qwen3_moe.Qwen3MoEConfig.from_hf(
+                hf, capacity_factor=cfg.moe_capacity_factor, **overrides
+            )
         if cfg.model_type == "qwen3":
             return qwen3.Qwen3Config.from_hf(hf, **overrides)
         return llama.LlamaConfig.from_hf(hf, **overrides)
@@ -55,6 +63,16 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
         tie_word_embeddings=cfg.tie_word_embeddings,
         **overrides,
     )
+    if cfg.model_type == "qwen3_moe":
+        return qwen3_moe.Qwen3MoEConfig(
+            qk_norm=True,
+            num_experts=cfg.num_experts,
+            num_experts_per_tok=cfg.num_experts_per_tok,
+            moe_intermediate_size=cfg.moe_intermediate_size
+            or (cfg.intermediate_size or 4 * cfg.hidden_size),
+            capacity_factor=cfg.moe_capacity_factor,
+            **common,
+        )
     if cfg.model_type == "qwen3":
         return qwen3.Qwen3Config(qk_norm=True, **common)
     if cfg.model_type == "llama":
@@ -71,7 +89,7 @@ def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg):
             sequence_length=cfg.sequence_length,
             micro_batch_size=cfg.micro_batch_size,
             gradient_accumulation_steps=cfg.gradient_accumulation_steps,
-            data_parallel_size=cfg.data_parallel_size,
+            data_parallel_size=cfg.data_parallel_size * cfg.expert_parallel_size,
             seed=cfg.seed,
         )
     from scaletorch_tpu.data.dataloader import MicroBatchDataLoader
@@ -89,7 +107,7 @@ def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg):
         tokens,
         micro_batch_size=cfg.micro_batch_size,
         gradient_accumulation_steps=cfg.gradient_accumulation_steps,
-        data_parallel_size=cfg.data_parallel_size,
+        data_parallel_size=cfg.data_parallel_size * cfg.expert_parallel_size,
         seed=cfg.seed,
     )
 
@@ -124,16 +142,41 @@ class Trainer:
         if cfg.tensor_parallel_size > 1:
             validate_tp_divisibility(self.model_cfg, cfg.tensor_parallel_size)
 
+        is_moe = cfg.model_type == "qwen3_moe"
+        if is_moe:
+            from scaletorch_tpu.models import qwen3_moe
+            from scaletorch_tpu.parallel.expert_parallel import (
+                validate_ep_divisibility,
+            )
+
+            if cfg.expert_parallel_size > 1:
+                validate_ep_divisibility(self.model_cfg, cfg.expert_parallel_size)
+            init_fn, fwd_fn = qwen3_moe.init_params, qwen3_moe.forward
+            param_specs = qwen3_moe.qwen3_moe_param_specs(
+                self.model_cfg,
+                tp_axis="tp",
+                ep_axis="ep" if cfg.expert_parallel_size > 1 else None,
+            )
+            model_kwargs = {
+                "ep_axis": "ep" if cfg.expert_parallel_size > 1 else None
+            }
+            head_weight_fn = qwen3_moe.lm_head_weight
+        else:
+            init_fn, fwd_fn = llama.init_params, llama.forward
+            param_specs = None
+            model_kwargs = None
+            head_weight_fn = None
+
         key = set_all_seed(cfg.seed)
         with jax.default_device(jax.devices()[0]):
-            params_host = llama.init_params(key, self.model_cfg)
+            params_host = init_fn(key, self.model_cfg)
 
         # clip-free optimizer: the SPMD step applies TP-correct clipping
         self.tx, self.schedule = create_optimizer(cfg, include_clip=False)
 
         self.step_fn, p_specs, o_specs = make_spmd_train_step(
             self.mm,
-            llama.forward,
+            fwd_fn,
             self.model_cfg,
             self.tx,
             params_host,
@@ -143,6 +186,9 @@ class Trainer:
             max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
             pp_schedule=cfg.pp_engine,
+            param_specs=param_specs,
+            model_kwargs=model_kwargs,
+            head_weight_fn=head_weight_fn,
         )
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)
@@ -154,8 +200,13 @@ class Trainer:
         }
 
         n_params = get_num_params(self.params)
+        # MoE MFU counts active params per token (reference active-param
+        # MFU, README.md:131).
+        mfu_params = (
+            self.model_cfg.num_active_params() if is_moe else n_params
+        )
         self.metrics = MetricsLogger(
-            num_params=n_params,
+            num_params=mfu_params,
             num_layers=self.model_cfg.num_hidden_layers,
             num_heads=self.model_cfg.num_attention_heads,
             head_dim=self.model_cfg.actual_head_dim,

@@ -103,7 +103,7 @@ def _pipeline(mm, params, ids, targets, **kw):
     from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
     def mean_loss(p, b):
-        axes = ("dp", "cp", "tp", "pp")
+        axes = ("dp", "cp", "ep", "tp", "pp")
         return jax.lax.pmean(pvary_missing(pipe_loss(p, b), axes), axes)
 
     f = jax.jit(
