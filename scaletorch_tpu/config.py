@@ -52,6 +52,14 @@ class ModelArguments:
         default=None,
         metadata={"help": "HF checkpoint dir/name to configure + load from."},
     )
+    load_pretrained_weights: bool = field(
+        default=False,
+        metadata={
+            "help": "Load HF safetensors weights from model_name_or_path "
+            "(otherwise random init with its architecture; reference "
+            "random-init fallback, checkpoint.py:90-97)."
+        },
+    )
     model_type: str = field(
         default="llama",
         metadata={"help": "llama | qwen3 | qwen3_moe | gpt_moe | lenet | mingpt"},

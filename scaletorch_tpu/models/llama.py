@@ -130,9 +130,10 @@ def init_params(key: jax.Array, cfg: LlamaConfig, *, mlp: bool = True) -> Params
     pd = cfg.param_dtype
 
     def stack_init(k, shape, fan_in):
-        # one independent fan-in-uniform slab per layer, stacked on axis 0
-        ks = jax.random.split(k, l)
-        return jnp.stack([fan_in_uniform(kk, shape, fan_in, pd) for kk in ks])
+        # one batched draw for all layers: fan-in-uniform bounds depend
+        # only on fan_in, so [L, ...] in a single RNG call is
+        # distributionally identical to per-layer slabs
+        return fan_in_uniform(k, (l,) + shape, fan_in, pd)
 
     layers: Params = {
         "input_layernorm": jnp.ones((l, h), pd),

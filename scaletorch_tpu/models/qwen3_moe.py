@@ -60,6 +60,18 @@ class Qwen3MoEConfig(Qwen3Config):
 
     @classmethod
     def from_hf(cls, hf_config, **overrides) -> "Qwen3MoEConfig":
+        # This build is all-MoE (every layer sparse); reject HF configs
+        # with interleaved dense layers rather than silently building a
+        # different architecture.
+        if getattr(hf_config, "mlp_only_layers", None):
+            raise NotImplementedError(
+                "mlp_only_layers (interleaved dense layers) is not supported"
+            )
+        if getattr(hf_config, "decoder_sparse_step", 1) not in (0, 1):
+            raise NotImplementedError(
+                "decoder_sparse_step > 1 (interleaved dense layers) is not "
+                "supported"
+            )
         kw = dict(
             num_experts=getattr(hf_config, "num_experts", 8),
             num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
@@ -102,11 +114,9 @@ def init_params(key: jax.Array, cfg: Qwen3MoEConfig) -> Params:
     keys = jax.random.split(jax.random.fold_in(key, 7), 4)
 
     def expert_stack(k, shape, fan_in):
-        ks = jax.random.split(k, l * e)
-        flat = jnp.stack(
-            [fan_in_uniform(kk, shape, fan_in, pd) for kk in ks]
-        )
-        return flat.reshape((l, e) + shape)
+        # one batched draw: fan-in-uniform bounds depend only on fan_in,
+        # so [L, E, ...] in a single RNG call is distributionally identical
+        return fan_in_uniform(k, (l, e) + shape, fan_in, pd)
 
     layers["router"] = 0.02 * jax.random.normal(keys[0], (l, h, e), pd)
     layers["expert_gate_proj"] = expert_stack(keys[1], (h, i), h)

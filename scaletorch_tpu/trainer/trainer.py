@@ -40,10 +40,14 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
 
         hf = AutoConfig.from_pretrained(cfg.model_name_or_path)
         if cfg.model_type == "qwen3_moe":
-            # training knobs (capacity etc.) are not in HF configs — thread
-            # the CLI values through alongside the architecture fields
+            # training knobs (capacity, loss coefs) are not in HF configs —
+            # thread the CLI values through alongside the architecture fields
             return qwen3_moe.Qwen3MoEConfig.from_hf(
-                hf, capacity_factor=cfg.moe_capacity_factor, **overrides
+                hf,
+                capacity_factor=cfg.moe_capacity_factor,
+                aux_loss_coef=cfg.router_aux_loss_coef,
+                z_loss_coef=cfg.router_z_loss_coef,
+                **overrides,
             )
         if cfg.model_type == "qwen3":
             return qwen3.Qwen3Config.from_hf(hf, **overrides)
@@ -71,6 +75,8 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
             moe_intermediate_size=cfg.moe_intermediate_size
             or (cfg.intermediate_size or 4 * cfg.hidden_size),
             capacity_factor=cfg.moe_capacity_factor,
+            aux_loss_coef=cfg.router_aux_loss_coef,
+            z_loss_coef=cfg.router_z_loss_coef,
             **common,
         )
     if cfg.model_type == "qwen3":
@@ -168,8 +174,20 @@ class Trainer:
             head_weight_fn = None
 
         key = set_all_seed(cfg.seed)
-        with jax.default_device(jax.devices()[0]):
-            params_host = init_fn(key, self.model_cfg)
+        if cfg.load_pretrained_weights:
+            if not cfg.model_name_or_path:
+                raise ValueError(
+                    "load_pretrained_weights requires model_name_or_path"
+                )
+            from scaletorch_tpu.utils.hf_interop import load_hf_params
+
+            # Assembled on host, distributed to the mesh sharding below via
+            # shard_params (reference materialization path,
+            # checkpoint.py:64-142).
+            params_host = load_hf_params(cfg.model_name_or_path, self.model_cfg)
+        else:
+            with jax.default_device(jax.devices()[0]):
+                params_host = init_fn(key, self.model_cfg)
 
         # clip-free optimizer: the SPMD step applies TP-correct clipping
         self.tx, self.schedule = create_optimizer(cfg, include_clip=False)

@@ -1,0 +1,244 @@
+"""HF-safetensors interop: load/save params in HuggingFace layout.
+
+Counterpart of the reference's load-time weight materialization
+(utils/checkpoint.py:23-464): ``init_model_with_materialized_weights``
+enumerates safetensors names per PP stage / EP rank
+(get_layer_names_in_sft_format, :265-337), TP-slices tensors on load
+(adjust_tensor_size, :339-423) and remaps HF names
+(convert_safetensors_to_hf_name, :425-464). The name-mapping tables here
+are that compatibility surface, ported semantically.
+
+TPU-native re-design:
+  * our params stack layers along axis 0 (scan layout), so loading is
+    name-map -> transpose -> stack, and **sharding happens by device_put
+    with a NamedSharding** — XLA distributes each global array to the
+    right shards; no per-rank slice bookkeeping (the reference's
+    adjust_tensor_size) is needed in-process.
+  * HF Linear weights are [out, in]; ours are einsum-friendly [in, out] —
+    every projection transposes on the way in/out.
+  * both directions are supported: ``load_hf_params`` (pretraining from a
+    HF checkpoint) and ``save_hf_params`` (export for HF inference) —
+    reference parity for the verify-weights tooling (tools/verify_qwen3.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Params = Dict[str, Any]
+
+# ours -> (HF template, transpose). {i} = layer index, {e} = expert index.
+_LAYER_MAP = {
+    "input_layernorm": ("model.layers.{i}.input_layernorm.weight", False),
+    "q_proj": ("model.layers.{i}.self_attn.q_proj.weight", True),
+    "k_proj": ("model.layers.{i}.self_attn.k_proj.weight", True),
+    "v_proj": ("model.layers.{i}.self_attn.v_proj.weight", True),
+    "o_proj": ("model.layers.{i}.self_attn.o_proj.weight", True),
+    "q_norm": ("model.layers.{i}.self_attn.q_norm.weight", False),
+    "k_norm": ("model.layers.{i}.self_attn.k_norm.weight", False),
+    "post_attention_layernorm": (
+        "model.layers.{i}.post_attention_layernorm.weight", False),
+    "gate_proj": ("model.layers.{i}.mlp.gate_proj.weight", True),
+    "up_proj": ("model.layers.{i}.mlp.up_proj.weight", True),
+    "down_proj": ("model.layers.{i}.mlp.down_proj.weight", True),
+    # MoE (Qwen3-MoE HF layout; reference convert_safetensors_to_hf_name
+    # maps global<->local expert ids, checkpoint.py:425-464)
+    "router": ("model.layers.{i}.mlp.gate.weight", True),
+    "expert_gate_proj": (
+        "model.layers.{i}.mlp.experts.{e}.gate_proj.weight", True),
+    "expert_up_proj": (
+        "model.layers.{i}.mlp.experts.{e}.up_proj.weight", True),
+    "expert_down_proj": (
+        "model.layers.{i}.mlp.experts.{e}.down_proj.weight", True),
+}
+
+_TOP_MAP = {
+    "embed_tokens": ("model.embed_tokens.weight", False),
+    "norm": ("model.norm.weight", False),
+    "lm_head": ("lm_head.weight", True),
+}
+
+
+def _open_shards(path: str):
+    """Yield (name -> np.ndarray getter) over all safetensors shards at
+    ``path`` (a directory with model.safetensors[.index.json] or a single
+    file)."""
+    from safetensors import safe_open
+
+    if os.path.isdir(path):
+        index = os.path.join(path, "model.safetensors.index.json")
+        if os.path.exists(index):
+            with open(index) as f:
+                weight_map = json.load(f)["weight_map"]
+            files = sorted(set(weight_map.values()))
+        else:
+            files = [
+                f for f in sorted(os.listdir(path)) if f.endswith(".safetensors")
+            ]
+        files = [os.path.join(path, f) for f in files]
+    else:
+        files = [path]
+
+    tensors: Dict[str, Any] = {}
+    handles = []
+    for f in files:
+        h = safe_open(f, framework="numpy")
+        handles.append(h)
+        for name in h.keys():
+            tensors[name] = h
+    return tensors, handles
+
+
+def load_hf_params(
+    path: str,
+    cfg,
+    *,
+    shardings: Optional[Any] = None,
+    param_dtype: Optional[Any] = None,
+) -> Params:
+    """Read a HF llama/qwen3/qwen3-moe safetensors checkpoint into our
+    stacked param tree.
+
+    ``shardings``: optional pytree of NamedSharding matching the param
+    tree — each assembled global array is device_put straight into its
+    sharding (the TP/PP/EP distribution the reference does by per-rank
+    slicing on load). Missing lm_head with tie_word_embeddings=True is
+    fine (tied head reads the embedding; reference
+    _handle_final_projection, checkpoint.py:223-251).
+    """
+    pd = param_dtype or cfg.param_dtype
+    tensors, handles = _open_shards(path)
+    is_moe = hasattr(cfg, "num_experts")
+
+    def get(name: str) -> np.ndarray:
+        if name not in tensors:
+            raise KeyError(
+                f"{name} not found in checkpoint at {path} "
+                f"({len(tensors)} tensors present)"
+            )
+        return tensors[name].get_tensor(name)
+
+    def fetch(template: str, transpose: bool, **fmt) -> np.ndarray:
+        t = get(template.format(**fmt))
+        t = np.asarray(t)
+        if t.dtype == np.dtype("V2"):  # raw bf16 comes out as void16
+            t = t.view(np.uint16)
+            t = jnp.asarray(t).view(jnp.bfloat16)
+            t = np.asarray(t.astype(jnp.float32))
+        return t.T if transpose else t
+
+    l = cfg.num_hidden_layers
+    layers: Params = {}
+    layer_keys = [
+        "input_layernorm", "q_proj", "k_proj", "v_proj", "o_proj",
+        "post_attention_layernorm",
+    ]
+    if getattr(cfg, "qk_norm", False):
+        layer_keys += ["q_norm", "k_norm"]
+    if is_moe:
+        layer_keys += ["router", "expert_gate_proj", "expert_up_proj",
+                       "expert_down_proj"]
+    else:
+        layer_keys += ["gate_proj", "up_proj", "down_proj"]
+
+    for key in layer_keys:
+        template, transpose = _LAYER_MAP[key]
+        if "{e}" in template:
+            stacked = np.stack([
+                np.stack([
+                    fetch(template, transpose, i=i, e=e)
+                    for e in range(cfg.num_experts)
+                ])
+                for i in range(l)
+            ])
+        else:
+            stacked = np.stack(
+                [fetch(template, transpose, i=i) for i in range(l)]
+            )
+        layers[key] = stacked.astype(pd)
+
+    params: Params = {
+        "embed_tokens": fetch(*_TOP_MAP["embed_tokens"]).astype(pd),
+        "layers": layers,
+        "norm": fetch(*_TOP_MAP["norm"]).astype(pd),
+    }
+    if not cfg.tie_word_embeddings:
+        template, transpose = _TOP_MAP["lm_head"]
+        if template in tensors:
+            params["lm_head"] = fetch(template, transpose).astype(pd)
+        else:
+            # some checkpoints tie silently: fall back to the embedding
+            params["lm_head"] = params["embed_tokens"].T.copy()
+
+    for h in handles:
+        # safe_open handles close on GC; be explicit where supported
+        close = getattr(h, "close", None)
+        if close:
+            close()
+
+    if shardings is not None:
+        params = jax.tree.map(
+            lambda x, s: jax.device_put(jnp.asarray(x), s), params, shardings
+        )
+    else:
+        params = jax.tree.map(jnp.asarray, params)
+    return params
+
+
+def save_hf_params(path: str, params: Params, cfg) -> str:
+    """Write our param tree as a HF-layout safetensors checkpoint
+    (single ``model.safetensors``). Returns the file path."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    is_moe = "expert_gate_proj" in params["layers"]
+    out: Dict[str, np.ndarray] = {}
+
+    def put(template: str, transpose: bool, value, **fmt):
+        v = np.asarray(jax.device_get(value), dtype=np.float32)
+        out[template.format(**fmt)] = v.T.copy() if transpose else v
+
+    put(*_TOP_MAP["embed_tokens"], params["embed_tokens"])
+    put(*_TOP_MAP["norm"], params["norm"])
+    if "lm_head" in params:
+        put(*_TOP_MAP["lm_head"], params["lm_head"])
+
+    for key, stacked in params["layers"].items():
+        template, transpose = _LAYER_MAP[key]
+        for i in range(stacked.shape[0]):
+            if "{e}" in template:
+                for e in range(stacked.shape[1]):
+                    put(template, transpose, stacked[i, e], i=i, e=e)
+            else:
+                put(template, transpose, stacked[i], i=i)
+
+    f = os.path.join(path, "model.safetensors")
+    save_file(out, f)
+    return f
+
+
+_HF_LAYER_RE = re.compile(r"model\.layers\.(\d+)\.")
+
+
+def hf_checkpoint_layer_names(path: str) -> Dict[int, list]:
+    """Enumerate checkpoint tensor names grouped by layer — the
+    introspection used for per-stage subset loading (reference
+    get_layer_names_in_sft_format, checkpoint.py:265-337)."""
+    tensors, handles = _open_shards(path)
+    by_layer: Dict[int, list] = {}
+    for name in tensors:
+        m = _HF_LAYER_RE.match(name)
+        if m:
+            by_layer.setdefault(int(m.group(1)), []).append(name)
+    for h in handles:
+        close = getattr(h, "close", None)
+        if close:
+            close()
+    return by_layer
