@@ -1,0 +1,16 @@
+"""Standalone attention-variant library: MHA / MQA / GQA / MLA.
+
+Parity with reference scaletorch/models/attention/{base,mha,mqa,gqa,
+mla}.py (852 LoC) — a self-contained educational family, not wired into
+the production decoders (reference models/__init__.py note). Functional
+JAX style: each variant is an ``init(key, cfg) -> params`` +
+``apply(params, x, ...) -> y`` pair over a shared config.
+"""
+
+from scaletorch_tpu.models.attention.base import AttentionConfig  # noqa: F401
+from scaletorch_tpu.models.attention.variants import (  # noqa: F401
+    GroupQueryAttention,
+    MultiHeadAttention,
+    MultiHeadLatentAttention,
+    MultiQueryAttention,
+)

@@ -1,0 +1,60 @@
+"""Shared config + scaffold for the attention-variant library.
+
+Parity with reference scaletorch/models/attention/base.py:12
+(``BaseAttention`` ABC: embed_dim/num_heads bookkeeping, dropout knobs,
+shape validation). Functional version: the config carries the
+bookkeeping; each variant supplies init/apply.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class AttentionConfig:
+    embed_dim: int = 256
+    num_heads: int = 8
+    num_kv_heads: Optional[int] = None  # GQA groups; 1 = MQA; None = MHA
+    head_dim: Optional[int] = None
+    # MLA latent dims (reference mla.py:60-66: q/kv down-up projections)
+    q_lora_rank: Optional[int] = None
+    kv_lora_rank: int = 64
+    dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.embed_dim % self.num_heads != 0:
+            raise ValueError(
+                f"embed_dim {self.embed_dim} not divisible by num_heads "
+                f"{self.num_heads}"
+            )
+        kv = self.num_kv_heads
+        if kv is not None and self.num_heads % kv != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by num_kv_heads {kv}"
+            )
+
+    @property
+    def actual_head_dim(self) -> int:
+        return self.head_dim or self.embed_dim // self.num_heads
+
+    @property
+    def actual_num_kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+
+class AttentionVariant:
+    """Thin OO veneer shared by all variants (reference BaseAttention)."""
+
+    def __init__(self, cfg: AttentionConfig):
+        self.cfg = cfg
+
+    def init(self, key: jax.Array):
+        raise NotImplementedError
+
+    def __call__(self, params, x: jax.Array, *, causal: bool = True):
+        raise NotImplementedError

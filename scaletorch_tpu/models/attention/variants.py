@@ -1,0 +1,132 @@
+"""MHA / MQA / GQA / MLA attention variants.
+
+Parity with reference scaletorch/models/attention/:
+  * ``MultiHeadAttention`` (mha.py:9) — full per-head K/V
+  * ``MultiQueryAttention`` (mqa.py:9) — single shared K/V head
+  * ``GroupQueryAttention`` (gqa.py:9) — grouped K/V heads
+  * ``MultiHeadLatentAttention`` (mla.py:9,60-66) — DeepSeek-style
+    low-rank q/kv down-up projections through a latent bottleneck
+
+All four are one parameterised implementation: MHA/MQA are GQA with
+kv_heads = heads / 1 (the same collapse the reference's class hierarchy
+expresses), MLA adds the latent projections in front.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from scaletorch_tpu.models.attention.base import AttentionConfig, AttentionVariant
+from scaletorch_tpu.models.layers import (
+    fan_in_uniform,
+    repeat_kv,
+    sdpa_attention,
+)
+
+Params = Dict[str, jax.Array]
+
+
+def _gqa_init(key: jax.Array, cfg: AttentionConfig, kv_heads: int) -> Params:
+    d, dh = cfg.embed_dim, cfg.actual_head_dim
+    nh = cfg.num_heads
+    ks = jax.random.split(key, 4)
+    pd = cfg.dtype
+    return {
+        "q_proj": fan_in_uniform(ks[0], (d, nh * dh), d, pd),
+        "k_proj": fan_in_uniform(ks[1], (d, kv_heads * dh), d, pd),
+        "v_proj": fan_in_uniform(ks[2], (d, kv_heads * dh), d, pd),
+        "o_proj": fan_in_uniform(ks[3], (nh * dh, d), nh * dh, pd),
+    }
+
+
+def _gqa_apply(
+    params: Params, x: jax.Array, cfg: AttentionConfig, kv_heads: int,
+    *, causal: bool = True,
+) -> jax.Array:
+    b, s, _ = x.shape
+    nh, dh = cfg.num_heads, cfg.actual_head_dim
+    q = (x @ params["q_proj"]).reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+    k = (x @ params["k_proj"]).reshape(b, s, kv_heads, dh).transpose(0, 2, 1, 3)
+    v = (x @ params["v_proj"]).reshape(b, s, kv_heads, dh).transpose(0, 2, 1, 3)
+    k = repeat_kv(k, nh // kv_heads)
+    v = repeat_kv(v, nh // kv_heads)
+    o = sdpa_attention(q, k, v, causal=causal)
+    return o.transpose(0, 2, 1, 3).reshape(b, s, nh * dh) @ params["o_proj"]
+
+
+class MultiHeadAttention(AttentionVariant):
+    """Per-head K/V (reference mha.py:9)."""
+
+    def init(self, key):
+        return _gqa_init(key, self.cfg, self.cfg.num_heads)
+
+    def __call__(self, params, x, *, causal: bool = True):
+        return _gqa_apply(params, x, self.cfg, self.cfg.num_heads, causal=causal)
+
+
+class MultiQueryAttention(AttentionVariant):
+    """One shared K/V head (reference mqa.py:9)."""
+
+    def init(self, key):
+        return _gqa_init(key, self.cfg, 1)
+
+    def __call__(self, params, x, *, causal: bool = True):
+        return _gqa_apply(params, x, self.cfg, 1, causal=causal)
+
+
+class GroupQueryAttention(AttentionVariant):
+    """Grouped K/V heads (reference gqa.py:9)."""
+
+    def init(self, key):
+        return _gqa_init(key, self.cfg, self.cfg.actual_num_kv_heads)
+
+    def __call__(self, params, x, *, causal: bool = True):
+        return _gqa_apply(
+            params, x, self.cfg, self.cfg.actual_num_kv_heads, causal=causal
+        )
+
+
+class MultiHeadLatentAttention(AttentionVariant):
+    """Low-rank latent q/kv projections (reference mla.py:9,60-66):
+    x -> down-project to a small latent -> up-project to per-head q/k/v.
+    The KV cache (in inference) would store only the latent."""
+
+    def init(self, key):
+        cfg = self.cfg
+        d, dh, nh = cfg.embed_dim, cfg.actual_head_dim, cfg.num_heads
+        qr = cfg.q_lora_rank or d
+        kr = cfg.kv_lora_rank
+        ks = jax.random.split(key, 6)
+        pd = cfg.dtype
+        params: Params = {
+            "kv_down": fan_in_uniform(ks[0], (d, kr), d, pd),
+            "k_up": fan_in_uniform(ks[1], (kr, nh * dh), kr, pd),
+            "v_up": fan_in_uniform(ks[2], (kr, nh * dh), kr, pd),
+            "o_proj": fan_in_uniform(ks[3], (nh * dh, d), nh * dh, pd),
+        }
+        if cfg.q_lora_rank:
+            params["q_down"] = fan_in_uniform(ks[4], (d, qr), d, pd)
+            params["q_up"] = fan_in_uniform(ks[5], (qr, nh * dh), qr, pd)
+        else:
+            params["q_proj"] = fan_in_uniform(ks[4], (d, nh * dh), d, pd)
+        return params
+
+    def __call__(self, params, x, *, causal: bool = True):
+        cfg = self.cfg
+        b, s, _ = x.shape
+        nh, dh = cfg.num_heads, cfg.actual_head_dim
+        if "q_down" in params:
+            q = (x @ params["q_down"]) @ params["q_up"]
+        else:
+            q = x @ params["q_proj"]
+        latent = x @ params["kv_down"]  # [B, S, kv_rank] — the cacheable state
+        k = latent @ params["k_up"]
+        v = latent @ params["v_up"]
+        q = q.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+        o = sdpa_attention(q, k, v, causal=causal)
+        return o.transpose(0, 2, 1, 3).reshape(b, s, nh * dh) @ params["o_proj"]

@@ -1,0 +1,79 @@
+"""LeNet-5 MNIST CNN — the minimal-example model.
+
+Parity with reference scaletorch/models/lenet.py:10-38 (two conv+pool
+blocks, three FC layers), functional JAX: convs via
+``lax.conv_general_dilated`` in NHWC (TPU-native layout; torch uses NCHW).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, jax.Array]
+
+
+@dataclass(frozen=True)
+class LeNetConfig:
+    num_classes: int = 10
+    in_channels: int = 1
+
+
+def init_params(key: jax.Array, cfg: LeNetConfig = LeNetConfig()) -> Params:
+    ks = jax.random.split(key, 5)
+
+    def conv_init(k, shape):  # HWIO
+        fan_in = shape[0] * shape[1] * shape[2]
+        bound = 1.0 / jnp.sqrt(fan_in)
+        return jax.random.uniform(k, shape, minval=-bound, maxval=bound)
+
+    def fc_init(k, shape):
+        bound = 1.0 / jnp.sqrt(shape[0])
+        return jax.random.uniform(k, shape, minval=-bound, maxval=bound)
+
+    return {
+        "conv1": conv_init(ks[0], (5, 5, cfg.in_channels, 6)),
+        "conv2": conv_init(ks[1], (5, 5, 6, 16)),
+        "fc1": fc_init(ks[2], (16 * 4 * 4, 120)),
+        "fc2": fc_init(ks[3], (120, 84)),
+        "fc3": fc_init(ks[4], (84, cfg.num_classes)),
+    }
+
+
+def _conv(x: jax.Array, w: jax.Array) -> jax.Array:
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _max_pool(x: jax.Array) -> jax.Array:
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID"
+    )
+
+
+def forward(params: Params, x: jax.Array) -> jax.Array:
+    """x: [B, 28, 28, C] -> logits [B, num_classes]."""
+    x = _max_pool(jax.nn.relu(_conv(x, params["conv1"])))  # [B,12,12,6]
+    x = _max_pool(jax.nn.relu(_conv(x, params["conv2"])))  # [B,4,4,16]
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"])
+    x = jax.nn.relu(x @ params["fc2"])
+    return x @ params["fc3"]
+
+
+class LeNet:
+    config_cls = LeNetConfig
+
+    def __init__(self, config: LeNetConfig = LeNetConfig()):
+        self.config = config
+
+    def init(self, key: jax.Array) -> Params:
+        return init_params(key, self.config)
+
+    def __call__(self, params: Params, x: jax.Array) -> jax.Array:
+        return forward(params, x)
