@@ -363,3 +363,70 @@ def pallas_flash_attention(
     bq = _pick_block(sq, block_q)
     bkv = _pick_block(skv, block_kv)
     return _flash(q, k, v, causal, scale, bq, bkv, interpret)
+
+
+# ---------------------------------------------------------------------------
+# raw entries for composition into outer custom-VJP ops (ring attention)
+# ---------------------------------------------------------------------------
+def flash_forward_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+    interpret: bool = False,
+):
+    """Raw kernel forward returning ``(out, lse)``.
+
+    NOT differentiable — the caller owns the VJP (ring attention merges
+    per-block (out, lse) partials across ``ppermute`` steps and drives the
+    block backward itself, the role of the reference's blockwise fwd inside
+    RingAttentionFunc, context_parallel.py:367-424).
+    """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    bq = _pick_block(q.shape[2], block_q)
+    bkv = _pick_block(k.shape[2], block_kv)
+    return _flash_forward(q, k, v, causal, scale, bq, bkv, interpret)
+
+
+def flash_block_backward(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    dout: jax.Array,
+    *,
+    causal: bool,
+    scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_kv: int = DEFAULT_BLOCK_KV,
+    interpret: bool = False,
+):
+    """Gradients of one K/V block against a GLOBAL softmax statistic.
+
+    ``out``/``lse`` are the final merged attention output and log-sum-exp
+    over ALL blocks (not just this one); the returned (dq, dk, dv) are then
+    exactly this block's additive contribution to the full gradients —
+    the identity the reference's dual-ring backward exploits
+    (context_parallel.py:184-263). dk/dv come back in the unexpanded
+    [B, Hkv, S, D] layout.
+    """
+    if q.shape[1] % k.shape[1]:
+        raise ValueError(
+            f"query heads {q.shape[1]} not a multiple of kv heads {k.shape[1]}"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    bq = _pick_block(q.shape[2], block_q)
+    bkv = _pick_block(k.shape[2], block_kv)
+    return _flash_backward(q, k, v, out, lse, dout, causal, scale, bq, bkv,
+                           interpret)

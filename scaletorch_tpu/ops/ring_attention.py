@@ -6,9 +6,15 @@ zhuzilin/ring-flash-attention), re-designed for TPU SPMD:
 
   * the K/V blocks circulate the cp ring with ``lax.ppermute`` (the
     reference queues isend/irecv pairs per step, cp_comms.py:117-176);
-  * blockwise softmax uses flash-style running-max/sum accumulation in
-    fp32 (the reference's sigmoid/logsigmoid LSE merge,
-    context_parallel.py:367-424, is the same recurrence);
+  * each ring step computes one blockwise attention piece and merges it
+    into a running ``(out, lse)`` pair — the reference's
+    sigmoid/logsigmoid LSE merge (context_parallel.py:367-424) is the
+    same recurrence;
+  * the per-block compute has two implementations: ``impl='pallas'``
+    runs the flash kernel (ops/pallas/flash.py) so the [S/cp, S/cp]
+    score tile never reaches HBM — the reference's whole point of
+    flash-inside-the-ring — and ``impl='xla'`` is the plain-softmax
+    fallback used on CPU;
   * the **causal skip** halves compute: with contiguous sequence shards,
     a query shard r never attends key shards j > r, so those steps run a
     ``lax.cond`` no-op branch (reference skips step>rank blocks,
@@ -18,19 +24,22 @@ zhuzilin/ring-flash-attention), re-designed for TPU SPMD:
     home with every rank's contribution (the reference's dual kv/dkv
     ring, :184-263). Without the custom vjp, autodiff through the
     forward ring would checkpoint every rotated K/V block and the memory
-    saving of CP would be lost.
+    saving of CP would be lost. The pallas block backward exploits the
+    flash identity: gradients of one block against the GLOBAL lse are
+    exactly that block's additive contribution.
 
 Inputs are the rank-local sequence shards [B, H, S/cp, D] (the loader
 ships contiguous shards; positions arrive via the sharded position_ids).
-GQA: K/V circulate **unexpanded** (fewer bytes on the ring) and are
-expanded per block.
+GQA: K/V circulate **unexpanded** (fewer bytes on the ring); the pallas
+kernel reads them unexpanded via index maps, the xla path expands per
+block.
 """
 
 from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -53,40 +62,43 @@ def _causal_mask(sq: int, sk: int):
     return jnp.tril(jnp.ones((sq, sk), dtype=bool))
 
 
-def _fwd_block(q, k, v, scale, causal_diag: bool):
-    """One blockwise attention piece -> (unnormalised acc, rowmax m, rowsum l)."""
-    s = _block_scores(q, k, scale)
-    if causal_diag:
-        s = jnp.where(_causal_mask(s.shape[-2], s.shape[-1]), s, -jnp.inf)
-    m = jnp.max(s, axis=-1)                      # [B, H, Sq]
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v).astype(jnp.float32)
-    return acc, m, l
+def _fwd_block(q, k, v, *, scale, causal_diag, impl, interpret, n_rep):
+    """One blockwise attention piece -> (normalised out fp32, lse fp32)."""
+    if impl == "pallas":
+        from scaletorch_tpu.ops.pallas.flash import flash_forward_with_lse
+
+        o, lse = flash_forward_with_lse(
+            q, k, v, causal=causal_diag, scale=scale, interpret=interpret
+        )
+        return o.astype(jnp.float32), lse
+    from scaletorch_tpu.models.layers import sdpa_attention_with_lse
+
+    o, lse = sdpa_attention_with_lse(q, k, v, causal=causal_diag, scale=scale)
+    return o.astype(jnp.float32), lse
 
 
-def _merge(acc, m, l, acc2, m2, l2):
-    """Merge two flash-style partial results (fp32)."""
-    m_new = jnp.maximum(m, m2)
-    w1 = jnp.exp(m - m_new)
-    w2 = jnp.exp(m2 - m_new)
-    return (
-        acc * w1[..., None] + acc2 * w2[..., None],
-        m_new,
-        l * w1 + l2 * w2,
-    )
+def _merge(o1, lse1, o2, lse2):
+    """Merge two normalised flash-style partial results (fp32)."""
+    m = jnp.maximum(lse1, lse2)
+    w1 = jnp.exp(lse1 - m)
+    w2 = jnp.exp(lse2 - m)
+    lsum = w1 + w2
+    o = (o1 * w1[..., None] + o2 * w2[..., None]) / lsum[..., None]
+    return o, m + jnp.log(lsum)
 
 
-def _ring_forward(q, k, v, axis: str, scale: float):
+def _ring_forward(q, k, v, axis: str, scale: float, impl: str, interpret: bool):
     """Returns (out [B,H,S,D] in q.dtype, lse fp32 [B,H,S])."""
     cp = jax.lax.axis_size(axis)
     r = jax.lax.axis_index(axis)
     n_rep = q.shape[1] // k.shape[1]
     perm = _ring_perm(axis)
+    blk = partial(_fwd_block, scale=scale, impl=impl, interpret=interpret,
+                  n_rep=n_rep)
 
     # step 0: the diagonal (own) block, causal-masked — every query row sees
     # at least itself, so accumulators start finite.
-    acc, m, l = _fwd_block(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), scale, True)
+    o, lse = blk(q, k, v, causal_diag=True)
 
     k_blk, v_blk = k, v
     for t in range(1, cp):
@@ -94,25 +106,21 @@ def _ring_forward(q, k, v, axis: str, scale: float):
         v_blk = jax.lax.ppermute(v_blk, axis, perm)
         j = (r - t) % cp  # origin rank of the block now held
 
-        def attend(acc=acc, m=m, l=l, k_blk=k_blk, v_blk=v_blk):
-            a2, m2, l2 = _fwd_block(
-                q, repeat_kv(k_blk, n_rep), repeat_kv(v_blk, n_rep), scale, False
-            )
-            return _merge(acc, m, l, a2, m2, l2)
+        def attend(o=o, lse=lse, k_blk=k_blk, v_blk=v_blk):
+            o2, lse2 = blk(q, k_blk, v_blk, causal_diag=False)
+            return _merge(o, lse, o2, lse2)
 
-        def skip(acc=acc, m=m, l=l):
-            return acc, m, l
+        def skip(o=o, lse=lse):
+            return o, lse
 
         # causal skip: key shard j holds positions AFTER ours when j > r
-        acc, m, l = jax.lax.cond(j < r, attend, skip)
+        o, lse = jax.lax.cond(j < r, attend, skip)
 
-    out = (acc / l[..., None]).astype(q.dtype)
-    lse = m + jnp.log(l)
-    return out, lse
+    return o.astype(q.dtype), lse
 
 
-def _bwd_block(q, k, v, dout, lse, delta, scale, causal_diag: bool):
-    """Gradients of one block: (dq, dk, dv) in fp32.
+def _bwd_block_xla(q, k, v, dout, lse, delta, scale, causal_diag: bool):
+    """Gradients of one pre-expanded block: (dq, dk, dv) in fp32.
 
     Standard flash backward: p = exp(s - lse); dv = p^T dout;
     ds = p * (dout v^T - delta) * scale; dq = ds k; dk = ds^T q.
@@ -139,35 +147,59 @@ def _sum_heads(d_expanded, n_rep):
     return d_expanded.reshape(b, h // n_rep, n_rep, s, d).sum(axis=2)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _bwd_block(q, k_blk, v_blk, out, lse, dout, delta, *,
+               scale, causal_diag, impl, interpret, n_rep):
+    """Per-ring-step block backward -> (dq, dk, dv) fp32, dk/dv unexpanded."""
+    if impl == "pallas":
+        from scaletorch_tpu.ops.pallas.flash import flash_block_backward
+
+        dq, dk, dv = flash_block_backward(
+            q, k_blk, v_blk, out, lse, dout,
+            causal=causal_diag, scale=scale, interpret=interpret,
+        )
+        return (dq.astype(jnp.float32), dk.astype(jnp.float32),
+                dv.astype(jnp.float32))
+    dq, dk, dv = _bwd_block_xla(
+        q, repeat_kv(k_blk, n_rep), repeat_kv(v_blk, n_rep),
+        dout, lse, delta, scale, causal_diag,
+    )
+    return dq, _sum_heads(dk, n_rep), _sum_heads(dv, n_rep)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def ring_attention(q, k, v, axis: str = "cp", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, impl: str = "xla",
+                   interpret: bool = False):
     """Ring attention over mesh axis ``axis``; call inside shard_map.
 
     q: [B, Hq, S/cp, D]; k/v: [B, Hkv, S/cp, D] (local shards).
     Only causal=True is supported (parity: the reference ring attention
     is causal-only, context_parallel.py:154-171).
+
+    ``impl='pallas'`` computes each ring block with the flash kernel so
+    per-step memory is O(S/cp · D), not O((S/cp)^2); ``impl='xla'`` is
+    the plain-softmax fallback (CPU tests).
     """
     if not causal:
         raise NotImplementedError("ring attention is causal-only")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    out, _ = _ring_forward(q, k, v, axis, scale)
+    out, _ = _ring_forward(q, k, v, axis, scale, impl, interpret)
     return out
 
 
-def _ring_fwd(q, k, v, axis, causal, scale):
+def _ring_fwd(q, k, v, axis, causal, scale, impl, interpret):
     # guard repeated here: under differentiation custom_vjp traces this
     # function instead of the primal body above
     if not causal:
         raise NotImplementedError("ring attention is causal-only")
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    out, lse = _ring_forward(q, k, v, axis, scale)
+    out, lse = _ring_forward(q, k, v, axis, scale, impl, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _ring_bwd(axis, causal, scale, res, dout):
+def _ring_bwd(axis, causal, scale, impl, interpret, res, dout):
     q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -175,16 +207,15 @@ def _ring_bwd(axis, causal, scale, res, dout):
     r = jax.lax.axis_index(axis)
     n_rep = q.shape[1] // k.shape[1]
     perm = _ring_perm(axis)
+    blk = partial(_bwd_block, scale=scale, impl=impl, interpret=interpret,
+                  n_rep=n_rep)
 
     # delta = rowsum(dout * out) — the softmax-jacobian diagonal term
+    # (the pallas path recomputes it inside flash_block_backward)
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
 
     # own (diagonal) block
-    dq, dk_own, dv_own = _bwd_block(
-        q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), dout, lse, delta, scale, True
-    )
-    dk_acc = _sum_heads(dk_own, n_rep)
-    dv_acc = _sum_heads(dv_own, n_rep)
+    dq, dk_acc, dv_acc = blk(q, k, v, out, lse, dout, delta, causal_diag=True)
 
     # Rotate (k, v, dk, dv) together: after the remaining cp-1 rotations
     # plus one final rotation, each dk/dv accumulator is back at its origin
@@ -199,13 +230,10 @@ def _ring_bwd(axis, causal, scale, res, dout):
 
         def contribute(dq=dq, dk_acc=dk_acc, dv_acc=dv_acc,
                        k_blk=k_blk, v_blk=v_blk):
-            dq_c, dk_c, dv_c = _bwd_block(
-                q, repeat_kv(k_blk, n_rep), repeat_kv(v_blk, n_rep),
-                dout, lse, delta, scale, False,
+            dq_c, dk_c, dv_c = blk(
+                q, k_blk, v_blk, out, lse, dout, delta, causal_diag=False
             )
-            return (dq + dq_c,
-                    dk_acc + _sum_heads(dk_c, n_rep),
-                    dv_acc + _sum_heads(dv_c, n_rep))
+            return dq + dq_c, dk_acc + dk_c, dv_acc + dv_c
 
         def skip(dq=dq, dk_acc=dk_acc, dv_acc=dv_acc):
             return dq, dk_acc, dv_acc
@@ -223,9 +251,20 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention_backend(q, k, v, *, causal: bool = True,
-                           scale: Optional[float] = None, axis: str = "cp"):
-    """Registry-compatible wrapper (backend name 'ring')."""
-    return ring_attention(q, k, v, axis, causal, scale)
+                           scale: Optional[float] = None, axis: str = "cp",
+                           impl: Optional[str] = None,
+                           interpret: bool = False):
+    """Registry-compatible wrapper (backend name 'ring').
+
+    Picks the flash-kernel block implementation on TPU, the XLA softmax
+    fallback elsewhere (same policy as the 'flash' backend dispatch,
+    ops/flash_attention.py).
+    """
+    if impl is None:
+        from scaletorch_tpu.ops.flash_attention import _pallas_available
+
+        impl = "pallas" if _pallas_available() else "xla"
+    return ring_attention(q, k, v, axis, causal, scale, impl, interpret)
 
 
 register_attention_backend("ring", ring_attention_backend)
