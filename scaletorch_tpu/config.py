@@ -43,7 +43,11 @@ class DataArguments:
         default=False,
         metadata={"help": "Use an on-device synthetic token stream (benchmarks)."},
     )
-    synthetic_vocab_size: int = field(default=32000, metadata={"help": ""})
+    synthetic_vocab_size: Optional[int] = field(
+        default=None,
+        metadata={"help": "Cap the synthetic stream's sampled token ids "
+                          "below the model vocab (default: model vocab)."},
+    )
 
 
 @dataclass
@@ -166,6 +170,11 @@ class TrainingArguments:
     gradient_checkpointing: bool = field(
         default=False, metadata={"help": "jax.checkpoint each decoder layer."}
     )
+    remat_policy: str = field(
+        default="nothing_saveable",
+        metadata={"help": "GC remat policy: nothing_saveable | dots_saveable | "
+                          "dots_with_no_batch_dims_saveable | save_attn."},
+    )
     donate_params: bool = field(
         default=True, metadata={"help": "Donate param/opt buffers in the jitted step."}
     )
@@ -184,8 +193,21 @@ class CheckpointArguments:
 class LoggingArguments:
     log_frequency: int = 1
     log_file: Optional[str] = None
-    performance_log_dir: Optional[str] = None
-    verbose: bool = False
+    performance_log_dir: Optional[str] = field(
+        default=None,
+        metadata={"help": "Dump the per-step metrics history as JSON here at "
+                          "the end of training (reference monitor.py role)."},
+    )
+    verbose: bool = field(
+        default=False, metadata={"help": "DEBUG-level logging."}
+    )
+    wandb_project: Optional[str] = field(
+        default=None,
+        metadata={"help": "Log metrics to this wandb project (reference "
+                          "metrics.py:95-114); silently skipped if wandb "
+                          "is not installed."},
+    )
+    wandb_run_name: Optional[str] = None
 
 
 @dataclass

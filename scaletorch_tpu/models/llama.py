@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 
 from scaletorch_tpu.models.layers import (
     apply_rotary_pos_emb,
@@ -243,6 +244,9 @@ def attention_block(
     v = v.transpose(0, 2, 1, 3)
     q, k = apply_rotary_pos_emb(q, k, pv(cos), pv(sin))
     attn = attn_fn(q, k, v, causal=True)
+    # Offer the attention output to the remat policy (the 'save_attn'
+    # policy keeps it instead of recomputing the whole block in backward).
+    attn = checkpoint_name(attn, "attn_out")
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, nh_l)
     return x + row(attn, layer["o_proj"])
 
@@ -340,6 +344,28 @@ def final_hidden(
     return x
 
 
+def resolve_remat_policy(name: str):
+    """Map a config-level policy name to a jax.checkpoint policy.
+
+    The reference's gradient checkpointing has exactly one mode — recompute
+    the whole layer (torch.utils.checkpoint, llama.py:534-545). On TPU the
+    policy is the main GC perf lever (VERDICT r1 #10): what gets saved
+    decides how much of the flash/ring attention is recomputed in backward.
+    """
+    cp = jax.checkpoint_policies
+    policies = {
+        "nothing_saveable": cp.nothing_saveable,
+        "dots_saveable": cp.dots_saveable,
+        "dots_with_no_batch_dims_saveable": cp.dots_with_no_batch_dims_saveable,
+        "save_attn": cp.save_only_these_names("attn_out"),
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; have {sorted(policies)}"
+        )
+    return policies[name]
+
+
 def decoder_stack(
     x: jax.Array,
     layers: Params,
@@ -351,6 +377,7 @@ def decoder_stack(
     tp_axis: Optional[str] = None,
     sequence_parallel: bool = False,
     gradient_checkpointing: bool = False,
+    remat_policy: str = "nothing_saveable",
 ) -> jax.Array:
     """Scan ``_decoder_layer`` over a stack of layer params (leading axis =
     layer index). Used by ``forward`` for the whole model and by pipeline
@@ -365,7 +392,7 @@ def decoder_stack(
 
     if gradient_checkpointing:
         layer_body = jax.checkpoint(
-            layer_body, policy=jax.checkpoint_policies.nothing_saveable
+            layer_body, policy=resolve_remat_policy(remat_policy)
         )
     x, _ = jax.lax.scan(layer_body, x, layers)
     return x
@@ -379,6 +406,7 @@ def forward(
     positions: Optional[jax.Array] = None,
     attention_backend: str = "sdpa",
     gradient_checkpointing: bool = False,
+    remat_policy: str = "nothing_saveable",
     tp_axis: Optional[str] = None,
     sequence_parallel: bool = False,
     return_hidden: bool = False,
@@ -409,6 +437,7 @@ def forward(
         x, params["layers"], cos, sin, cfg, attn_fn,
         tp_axis=tp_axis, sequence_parallel=sequence_parallel,
         gradient_checkpointing=gradient_checkpointing,
+        remat_policy=remat_policy,
     )
     x = final_hidden(params, x, cfg, tp_axis=tp_axis,
                      sequence_parallel=sequence_parallel)

@@ -66,14 +66,25 @@ def top_k_routing(
       combine  [N, E, C] — dispatch · gating weight
       aux      {'aux_loss', 'z_loss', 'expert_load', 'dropped_fraction'}
 
-    Gate math matches the reference MoERouter (model_qwen3_moe.py:48-89):
-    softmax over ALL experts, take top-k, optionally renormalise the top-k
-    weights to sum to 1. The aux loss is the Switch load-balance loss
-    E · Σ_e f_e · P_e with f the fraction of tokens whose top-1..k choice
-    lands on e and P the mean router probability. Tokens beyond an
-    expert's capacity are dropped (contribute zero output — residual
-    passes them through), matching capacity-based MoE semantics
-    (moe.py:510-600).
+    Gate math: softmax over ALL experts, take top-k, optionally
+    renormalise the top-k weights to sum to 1. With
+    ``normalize_weights=True`` (default) this equals the reference
+    MoERouter exactly — softmax_all(topk)/Σ ≡ softmax over the top-k
+    logits (model_qwen3_moe.py:48-89; the reference's own norm_topk_prob
+    renorm is a no-op since its softmax already sums to 1). With
+    ``normalize_weights=False`` the weights follow HF transformers'
+    norm_topk_prob=False semantics (full-softmax weights, sum < 1) and
+    diverge from the reference, which always sums to 1.
+
+    The aux loss is the Switch load-balance loss E · Σ_e f_e · P_e / k,
+    with f the fraction of (token, choice) pairs landing on e (so f sums
+    to k) and P the mean router probability. The 1/k matches HF
+    transformers' load_balancing_loss_func — calibrate
+    ``router_aux_loss_coef`` against HF; the reference omits the 1/k
+    (model_qwen3_moe.py:74-88), so its coefficient is k× weaker for the
+    same value. Tokens beyond an expert's capacity are dropped
+    (contribute zero output — residual passes them through), matching
+    capacity-based MoE semantics (moe.py:510-600).
     """
     n, e = router_logits.shape
     logits32 = router_logits.astype(jnp.float32)

@@ -200,6 +200,7 @@ def make_llama_pipeline_loss(
     *,
     attention_backend: str = "sdpa",
     gradient_checkpointing: bool = False,
+    remat_policy: str = "nothing_saveable",
     sequence_parallel: bool = False,
     tp_axis: Optional[str] = "tp",
     pp_axis: str = "pp",
@@ -236,6 +237,7 @@ def make_llama_pipeline_loss(
             x, params["layers"], cos, sin, model_cfg, attn_fn,
             tp_axis=tp, sequence_parallel=sp,
             gradient_checkpointing=gradient_checkpointing,
+            remat_policy=remat_policy,
         )
 
     def loss_fn(params, x_m, t_m):

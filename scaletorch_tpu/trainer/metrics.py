@@ -45,11 +45,14 @@ class MetricsLogger:
         if self.peak_flops is None:
             self.peak_flops = get_theoretical_flops()
 
-    def log_step(self, step: int, loss, lr: float, grad_norm) -> dict:
+    def log_step(self, step: int, loss, lr: float, grad_norm,
+                 extras: Optional[dict] = None) -> dict:
         """Call every step; materialises/logs only on logging steps.
 
-        ``loss``/``grad_norm`` may be device scalars — they are converted
-        (forcing a host sync) only when this step actually logs.
+        ``loss``/``grad_norm``/``extras`` values may be device scalars —
+        they are converted (forcing a host sync) only when this step
+        actually logs. ``extras`` carries step-specific scalars from the
+        train step (e.g. MoE moe_dropped_fraction / moe_load_cv).
         """
         if step % self.log_frequency != 0:
             return {}
@@ -61,6 +64,8 @@ class MetricsLogger:
             "lr": float(lr),
             "grad_norm": float(grad_norm),
         }
+        for k, v in (extras or {}).items():
+            record[k] = float(v)
         if self._window_start_time is not None:
             elapsed = now - self._window_start_time
             steps_in_window = step - self._window_start_step
@@ -105,7 +110,42 @@ class MetricsLogger:
                     f"tok/s/chip {to_readable_format(record['tokens_per_second_per_chip'])}",
                     f"MFU {record['mfu']:.1f}%",
                 ]
+            if "moe_dropped_fraction" in record:
+                parts.append(f"drop {record['moe_dropped_fraction']:.2%}")
+            if "moe_load_cv" in record:
+                parts.append(f"load_cv {record['moe_load_cv']:.2f}")
             if "memory_gb" in record:
                 parts.append(f"mem {record['memory_gb']:.1f}GB")
             get_logger().info(" | ".join(parts))
         return record
+
+    def save_json(self, path: str) -> str:
+        """Dump the full metrics history as JSON (reference
+        PerformanceMonitor.save_stats, monitor.py:220-250)."""
+        import json
+        import os
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        summary = {}
+        rates = [r["tokens_per_second"] for r in self.history
+                 if "tokens_per_second" in r]
+        if rates:
+            summary = {
+                "mean_tokens_per_second": sum(rates) / len(rates),
+                "mean_mfu": sum(r["mfu"] for r in self.history
+                                if "mfu" in r) / len(rates),
+            }
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "num_params": self.num_params,
+                    "seq_len": self.seq_len,
+                    "num_chips": self.num_chips,
+                    "peak_flops": self.peak_flops,
+                    "summary": summary,
+                    "records": self.history,
+                },
+                f,
+                indent=1,
+            )
+        return path

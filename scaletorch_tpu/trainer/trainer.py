@@ -91,7 +91,8 @@ def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg):
         from scaletorch_tpu.data.dataloader import SyntheticDataLoader
 
         return SyntheticDataLoader(
-            vocab_size=model_cfg.vocab_size,
+            vocab_size=min(model_cfg.vocab_size,
+                           cfg.synthetic_vocab_size or model_cfg.vocab_size),
             sequence_length=cfg.sequence_length,
             micro_batch_size=cfg.micro_batch_size,
             gradient_accumulation_steps=cfg.gradient_accumulation_steps,
@@ -124,6 +125,10 @@ class Trainer:
     def __init__(self, cfg: ScaleTorchTPUArguments):
         self.cfg = cfg
         self.logger = get_logger(log_file=cfg.log_file)
+        if cfg.verbose:
+            import logging
+
+            self.logger.setLevel(logging.DEBUG)
         cfg.validate_world_size(len(jax.devices()))
         self.mm: MeshManager = setup_mesh_manager(**cfg.mesh_kwargs())
         self.model_cfg = build_model_config(cfg)
@@ -164,7 +169,8 @@ class Trainer:
                 ep_axis="ep" if cfg.expert_parallel_size > 1 else None,
             )
             model_kwargs = {
-                "ep_axis": "ep" if cfg.expert_parallel_size > 1 else None
+                "ep_axis": "ep" if cfg.expert_parallel_size > 1 else None,
+                "return_moe_stats": True,
             }
             head_weight_fn = qwen3_moe.lm_head_weight
         else:
@@ -200,6 +206,7 @@ class Trainer:
             params_host,
             attention_backend=self.attention_backend,
             gradient_checkpointing=cfg.gradient_checkpointing,
+            remat_policy=cfg.remat_policy,
             sequence_parallel=cfg.sequence_parallel,
             max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
@@ -242,6 +249,21 @@ class Trainer:
         self.tokens_seen = 0
         self._ckpt_mgr = None
 
+        self._wandb = None
+        if cfg.wandb_project and jax.process_index() == 0:
+            try:
+                import dataclasses as _dc
+
+                import wandb
+
+                self._wandb = wandb.init(
+                    project=cfg.wandb_project,
+                    name=cfg.wandb_run_name,
+                    config=_dc.asdict(cfg),
+                )
+            except Exception as exc:  # wandb not baked into the image
+                self.logger.warning(f"wandb requested but unavailable: {exc!r}")
+
     @property
     def checkpoint_manager(self):
         if self._ckpt_mgr is None:
@@ -278,7 +300,11 @@ class Trainer:
                 # update just applied used count = global_step - 1.
                 lr=float(self.schedule(self.global_step - 1)),
                 grad_norm=m["grad_norm"],
+                extras={k: v for k, v in m.items()
+                        if k not in ("loss", "grad_norm")},
             )
+            if last and self._wandb is not None:
+                self._wandb.log(last, step=self.global_step)
             if (
                 self.cfg.save_frequency
                 and self.cfg.checkpoint_dir
@@ -287,7 +313,26 @@ class Trainer:
                 self.save_checkpoint()
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()  # drain any in-flight async save
+        if self.cfg.performance_log_dir:
+            # every process dumps its own history (reference writes
+            # performance_logs_<rank>_<ts>.json per rank, train.py:439-443)
+            import os
+
+            path = self.metrics.save_json(os.path.join(
+                self.cfg.performance_log_dir,
+                f"performance_log_proc{jax.process_index()}"
+                f"_step{self.global_step}.json",
+            ))
+            self.logger.info(f"performance log written to {path}")
         return last
+
+    def close(self) -> None:
+        """Release external resources (wandb run, async checkpoint pool)."""
+        if self._wandb is not None:
+            self._wandb.finish()
+            self._wandb = None
+        if self._ckpt_mgr is not None:
+            self._ckpt_mgr.wait()
 
     def save_checkpoint(self) -> None:
         self.checkpoint_manager.save(

@@ -26,6 +26,7 @@ from __future__ import annotations
 import json
 import os
 import re
+import warnings
 from typing import Any, Dict, Optional
 
 import jax
@@ -71,6 +72,29 @@ def _open_shards(path: str):
     ``path`` (a directory with model.safetensors[.index.json] or a single
     file)."""
     from safetensors import safe_open
+
+    if not os.path.exists(path):
+        # A hub name like "Qwen/Qwen3-0.6B" would otherwise fail deep inside
+        # safe_open with a confusing file-not-found (ADVICE r1): resolve it
+        # to a local snapshot when huggingface_hub can, else explain.
+        if re.match(r"^[\w.-]+/[\w.-]+$", path):
+            try:
+                from huggingface_hub import snapshot_download
+
+                path = snapshot_download(path, allow_patterns=[
+                    "*.safetensors", "*.safetensors.index.json", "*.json",
+                ])
+            except Exception as exc:
+                raise FileNotFoundError(
+                    f"{path!r} looks like a HF hub name but could not be "
+                    f"downloaded ({exc!r}); pass a local directory containing "
+                    "the model's .safetensors files instead."
+                ) from exc
+        else:
+            raise FileNotFoundError(
+                f"checkpoint path {path!r} does not exist; expected a local "
+                ".safetensors file or a directory containing them"
+            )
 
     if os.path.isdir(path):
         index = os.path.join(path, "model.safetensors.index.json")
@@ -174,7 +198,16 @@ def load_hf_params(
         if template in tensors:
             params["lm_head"] = fetch(template, transpose).astype(pd)
         else:
-            # some checkpoints tie silently: fall back to the embedding
+            # some checkpoints tie silently: fall back to the embedding —
+            # but an untied config with a missing/misnamed head would load
+            # wrong logits without a trace, so say so (ADVICE r1).
+            warnings.warn(
+                f"config has tie_word_embeddings=False but {template!r} is "
+                f"missing from the checkpoint at {path}; falling back to the "
+                "transposed embedding table (tied head). If the checkpoint "
+                "really has an untied head, check its tensor names.",
+                stacklevel=2,
+            )
             params["lm_head"] = params["embed_tokens"].T.copy()
 
     for h in handles:

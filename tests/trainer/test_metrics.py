@@ -1,0 +1,46 @@
+"""MetricsLogger: extras plumbing + JSON history dump (reference
+monitor.py:220-250 save_stats role)."""
+
+import json
+
+from scaletorch_tpu.trainer.metrics import MetricsLogger
+
+
+def make_logger(**kw):
+    defaults = dict(
+        num_params=1_000_000, num_layers=2, num_heads=4, head_dim=16,
+        seq_len=128, tokens_per_step=256, num_chips=1, log_frequency=1,
+        peak_flops=1e12,
+    )
+    defaults.update(kw)
+    return MetricsLogger(**defaults)
+
+
+class TestExtras:
+    def test_extras_reach_record(self):
+        m = make_logger()
+        rec = m.log_step(1, loss=2.0, lr=1e-3, grad_norm=0.5,
+                         extras={"moe_dropped_fraction": 0.01,
+                                 "moe_load_cv": 0.3})
+        assert rec["moe_dropped_fraction"] == 0.01
+        assert rec["moe_load_cv"] == 0.3
+
+    def test_non_logging_step_skips(self):
+        m = make_logger(log_frequency=10)
+        assert m.log_step(3, loss=2.0, lr=1e-3, grad_norm=0.5) == {}
+
+
+class TestSaveJson:
+    def test_round_trip(self, tmp_path):
+        m = make_logger()
+        for step in range(1, 4):
+            m.log_step(step, loss=3.0 - step * 0.1, lr=1e-3, grad_norm=1.0)
+        path = m.save_json(str(tmp_path / "perf" / "log.json"))
+        with open(path) as f:
+            data = json.load(f)
+        assert len(data["records"]) == 3
+        assert data["records"][0]["loss"] == 2.9
+        assert data["num_params"] == 1_000_000
+        # windows after the first logged step carry rate metrics
+        assert "tokens_per_second" in data["records"][-1]
+        assert data["summary"]["mean_tokens_per_second"] > 0

@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         get_logger().warning("interrupted; exiting")
         return 130
+    finally:
+        # drain in-flight async checkpoint saves + finish wandb even on
+        # interrupt/error (reference aborts with cleanup, train.py:257-268)
+        trainer.close()
     if cfg.checkpoint_dir and cfg.save_frequency:
         trainer.save_checkpoint()
     get_logger().info(f"done: {last}")
