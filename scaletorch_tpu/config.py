@@ -129,6 +129,39 @@ class ParallelArguments:
 
 
 @dataclass
+class DistributedArguments:
+    """Multi-host bootstrap knobs (reference dist/utils.py:78-143 init_dist).
+
+    All optional: 'auto' detects SLURM/MPI/env launchers and stays
+    single-process when none is present.
+    """
+
+    distributed_launcher: str = field(
+        default="auto",
+        metadata={"help": "auto | env | slurm | mpi | none — how to discover "
+                          "the coordinator (reference init_dist launcher)."},
+    )
+    coordinator_address: Optional[str] = field(
+        default=None,
+        metadata={"help": "host:port of process 0 (env launcher); defaults to "
+                          "JAX_COORDINATOR_ADDRESS or MASTER_ADDR:MASTER_PORT."},
+    )
+    num_processes: Optional[int] = field(
+        default=None, metadata={"help": "Total process count (env launcher)."}
+    )
+    process_id: Optional[int] = field(
+        default=None, metadata={"help": "This process's rank (env launcher)."}
+    )
+
+    def __post_init__(self) -> None:
+        if self.distributed_launcher not in ("auto", "env", "slurm", "mpi", "none"):
+            raise ValueError(
+                f"distributed_launcher must be auto|env|slurm|mpi|none, "
+                f"got {self.distributed_launcher!r}"
+            )
+
+
+@dataclass
 class LrSchedulerArguments:
     lr_scheduler_type: str = field(
         default="cosine",
@@ -215,6 +248,7 @@ class ScaleTorchTPUArguments(
     DataArguments,
     ModelArguments,
     ParallelArguments,
+    DistributedArguments,
     LrSchedulerArguments,
     OptimizerArguments,
     TrainingArguments,
@@ -225,6 +259,7 @@ class ScaleTorchTPUArguments(
 
     def __post_init__(self) -> None:
         ParallelArguments.__post_init__(self)
+        DistributedArguments.__post_init__(self)
         if self.sequence_length % self.context_parallel_size != 0:
             raise ValueError(
                 f"sequence_length {self.sequence_length} not divisible by "

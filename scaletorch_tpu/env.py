@@ -35,6 +35,16 @@ def env_snapshot() -> dict[str, Any]:
     return {k: get_env(k) for k in sorted(_REGISTRY)}
 
 
+# ---- process-rank discovery (shared by dist.py and logger.py) ---------------
+# The first three are the explicit 'env' launcher contract
+# (dist.init_distributed); the scheduler-set tail is only a pre-backend-init
+# fallback for log gating (logger._process_index_noinit).
+ENV_LAUNCHER_RANK_VARS: tuple[str, ...] = ("JAX_PROCESS_ID", "PROCESS_ID", "RANK")
+RANK_DISCOVERY_VARS: tuple[str, ...] = ENV_LAUNCHER_RANK_VARS + (
+    "SLURM_PROCID",
+    "OMPI_COMM_WORLD_RANK",
+)
+
 # ---- core toggles (parity with reference scaletorch/env.py) -----------------
 register_env("FLASH_ATTEN", "1", _as_bool)          # use pallas flash attention
 register_env("CONTEXT_PARALLEL", "0", _as_bool)     # ring attention enabled

@@ -26,7 +26,7 @@ from scaletorch_tpu.models.registry import register_attention_backend
 def _pallas_available() -> bool:
     if get_env("SCALETORCH_TPU_DISABLE_PALLAS"):
         return False
-    return jax.devices()[0].platform == "tpu"
+    return jax.local_devices()[0].platform == "tpu"
 
 
 def flash_attention(

@@ -364,7 +364,13 @@ def make_spmd_train_step(
 
 
 def shard_params(mm: MeshManager, params: Any, p_specs: Any) -> Any:
-    return jax.device_put(
-        params, jax.tree.map(lambda s: NamedSharding(mm.mesh, s), p_specs,
-                             is_leaf=lambda x: isinstance(x, P))
+    """Distribute a host param tree to its mesh shardings. Multi-process
+    safe: every process holds the same host tree (same init seed / same
+    checkpoint) and contributes only its addressable shards."""
+    from scaletorch_tpu.dist import put_global
+
+    shardings = jax.tree.map(
+        lambda s: NamedSharding(mm.mesh, s), p_specs,
+        is_leaf=lambda x: isinstance(x, P),
     )
+    return jax.tree.map(put_global, params, shardings)

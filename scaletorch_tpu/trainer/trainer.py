@@ -129,6 +129,25 @@ class Trainer:
             import logging
 
             self.logger.setLevel(logging.DEBUG)
+        # Multi-host bootstrap BEFORE the first backend touch — after this,
+        # jax.devices() spans every host and the rest of the trainer is
+        # multi-process-agnostic (reference init_dist call site,
+        # train.py:70-76).
+        from scaletorch_tpu.dist import init_distributed
+
+        init_distributed(
+            cfg.distributed_launcher,
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+        # The logger was configured before the backend was up and may have
+        # guessed rank 0 (e.g. flags-only env launcher): correct the
+        # non-main-process gating now that the true index is known.
+        if jax.process_index() != 0:
+            import logging
+
+            self.logger.setLevel(logging.ERROR)
         cfg.validate_world_size(len(jax.devices()))
         self.mm: MeshManager = setup_mesh_manager(**cfg.mesh_kwargs())
         self.model_cfg = build_model_config(cfg)
@@ -192,7 +211,9 @@ class Trainer:
             # checkpoint.py:64-142).
             params_host = load_hf_params(cfg.model_name_or_path, self.model_cfg)
         else:
-            with jax.default_device(jax.devices()[0]):
+            # local_devices: under multi-process, jax.devices()[0] may belong
+            # to another host and its arrays would be unreadable here.
+            with jax.default_device(jax.local_devices()[0]):
                 params_host = init_fn(key, self.model_cfg)
 
         # clip-free optimizer: the SPMD step applies TP-correct clipping
@@ -277,8 +298,12 @@ class Trainer:
         return self._ckpt_mgr
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
+        # put_global: device_put single-process; per-process addressable
+        # shards of the (deterministic, identical) host batch multi-process.
+        from scaletorch_tpu.dist import put_global
+
         return {
-            k: jax.device_put(jnp.asarray(v), self._batch_shardings[k])
+            k: put_global(np.asarray(v), self._batch_shardings[k])
             for k, v in batch.items()
         }
 

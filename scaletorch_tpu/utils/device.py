@@ -46,7 +46,7 @@ def register_device_flops(kind_substring: str, flops: float) -> None:
 
 
 def get_device_kind(device: Optional[jax.Device] = None) -> str:
-    device = device or jax.devices()[0]
+    device = device or jax.local_devices()[0]
     return device.device_kind
 
 
@@ -75,7 +75,7 @@ def device_memory_stats(device: Optional[jax.Device] = None) -> dict[str, float]
     jax.Device.memory_stats() (TPU backends report bytes_in_use /
     peak_bytes_in_use / bytes_limit; CPU returns {}).
     """
-    device = device or jax.devices()[0]
+    device = device or jax.local_devices()[0]
     stats = device.memory_stats() or {}
     return {
         "bytes_in_use": float(stats.get("bytes_in_use", 0)),
@@ -85,7 +85,7 @@ def device_memory_stats(device: Optional[jax.Device] = None) -> dict[str, float]
 
 
 def is_tpu() -> bool:
-    return jax.devices()[0].platform == "tpu"
+    return jax.local_devices()[0].platform == "tpu"
 
 
 def bf16_supported() -> bool:

@@ -23,6 +23,42 @@ _COLORS = {
 _RESET = "\x1b[0m"
 
 
+def _process_index_noinit() -> int:
+    """Best-effort process index WITHOUT initialising the XLA backend.
+
+    Touching ``jax.process_index()`` before ``jax.distributed.initialize``
+    would lock the runtime single-process, so the logger must not be the
+    first backend touch. When the backend is already up, ask it; otherwise
+    trust the launcher env (same names init_distributed resolves).
+    """
+    try:
+        from jax._src import xla_bridge
+
+        backend_up = bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        # Unknown jax internals: assume NOT up — a wrong log level is
+        # recoverable, an accidentally-initialised backend (which would
+        # break a later jax.distributed.initialize) is not.
+        backend_up = False
+    if backend_up:
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:
+            return 0
+    from scaletorch_tpu.env import RANK_DISCOVERY_VARS
+
+    for var in RANK_DISCOVERY_VARS:
+        v = os.environ.get(var)
+        if v not in (None, ""):
+            try:
+                return int(v)
+            except ValueError:
+                pass
+    return 0
+
+
 class ColorfulFormatter(logging.Formatter):
     def __init__(self, process_index: int, use_color: bool = True) -> None:
         super().__init__()
@@ -57,12 +93,7 @@ def get_logger(
     if configured and not wants_file:
         return logger
 
-    try:
-        import jax
-
-        process_index = jax.process_index()
-    except Exception:
-        process_index = 0
+    process_index = _process_index_noinit()
 
     logger.setLevel(level if process_index == 0 else logging.ERROR)
     logger.propagate = False

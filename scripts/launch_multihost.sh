@@ -1,0 +1,72 @@
+#!/bin/bash
+# Multi-host training launcher — SSH fan-out over a node list.
+#
+# Role parity with reference scripts/torch_dist/launch_multi_nodes.sh
+# (per-node SSH launch, per-node logs, Ctrl-C cleanup) adapted to the JAX
+# runtime: instead of torchrun's RANK/WORLD_SIZE per *device* process, one
+# process per host is started with JAX_COORDINATOR_ADDRESS /
+# JAX_NUM_PROCESSES / JAX_PROCESS_ID, and jax.distributed.initialize
+# (scaletorch_tpu/dist.py) wires them into one global device mesh.
+#
+# On TPU pod slices created with GKE/queued resources you normally don't
+# need this script at all: `jax.distributed.initialize()` auto-discovers
+# the slice topology from TPU metadata, so just run the same train.py on
+# every VM (e.g. with `gcloud compute tpus tpu-vm ssh --worker=all`).
+# Under SLURM, `srun python train.py ...` is enough — the slurm launcher
+# is auto-detected (scaletorch_tpu/dist.py infer_launcher).
+#
+# Usage:
+#   bash scripts/launch_multihost.sh node_list.txt -- \
+#       python train.py --data_parallel_size 32 ...
+#
+# node_list.txt: one hostname/IP per line ('#' comments and blanks ignored).
+# Env overrides: SSH_USER, COORD_PORT (default 29500), LOG_DIR.
+
+set -euo pipefail
+
+NODE_LIST_FILE="${1:?usage: launch_multihost.sh NODE_LIST_FILE -- CMD...}"
+shift
+[ "${1:-}" = "--" ] && shift
+[ $# -gt 0 ] || { echo "no training command given after --" >&2; exit 1; }
+
+mapfile -t NODES < <(grep -v -e '^\s*$' -e '^\s*#' "$NODE_LIST_FILE")
+NUM_NODES=${#NODES[@]}
+[ "$NUM_NODES" -gt 0 ] || { echo "node list '$NODE_LIST_FILE' is empty" >&2; exit 1; }
+
+COORD_PORT="${COORD_PORT:-29500}"
+COORD_ADDR="${NODES[0]}:$COORD_PORT"
+SSH_USER="${SSH_USER:-$USER}"
+LOG_DIR="${LOG_DIR:-./multihost_logs/$(date +%Y-%m-%d_%H-%M-%S)}"
+mkdir -p "$LOG_DIR"
+
+PIDS=()
+cleanup() {
+    echo "cleaning up remote processes..." >&2
+    for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
+}
+trap cleanup INT TERM
+
+echo "launching $NUM_NODES processes, coordinator $COORD_ADDR, logs in $LOG_DIR"
+for i in "${!NODES[@]}"; do
+    node="${NODES[$i]}"
+    log="$LOG_DIR/proc-${i}_${node}.log"
+    ssh -o StrictHostKeyChecking=no -o BatchMode=yes "$SSH_USER@$node" "
+        cd '$PWD' 2>/dev/null || true
+        export JAX_COORDINATOR_ADDRESS='$COORD_ADDR'
+        export JAX_NUM_PROCESSES='$NUM_NODES'
+        export JAX_PROCESS_ID='$i'
+        exec $*
+    " > "$log" 2>&1 &
+    PIDS+=($!)
+done
+
+fail=0
+for i in "${!PIDS[@]}"; do
+    if wait "${PIDS[$i]}"; then
+        echo "[ok]   process $i (${NODES[$i]})"
+    else
+        echo "[FAIL] process $i (${NODES[$i]}) — see $LOG_DIR/proc-${i}_${NODES[$i]}.log"
+        fail=1
+    fi
+done
+exit $fail

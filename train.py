@@ -32,6 +32,10 @@ def main(argv=None) -> int:
         trainer.load_checkpoint()
     try:
         last = trainer.train()
+        # final save BEFORE close() so the async dispatch is drained by
+        # close()'s wait — otherwise the process could exit mid-write
+        if cfg.checkpoint_dir and cfg.save_frequency:
+            trainer.save_checkpoint()
     except KeyboardInterrupt:
         get_logger().warning("interrupted; exiting")
         return 130
@@ -39,8 +43,6 @@ def main(argv=None) -> int:
         # drain in-flight async checkpoint saves + finish wandb even on
         # interrupt/error (reference aborts with cleanup, train.py:257-268)
         trainer.close()
-    if cfg.checkpoint_dir and cfg.save_frequency:
-        trainer.save_checkpoint()
     get_logger().info(f"done: {last}")
     return 0
 
