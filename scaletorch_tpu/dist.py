@@ -56,9 +56,10 @@ def infer_launcher() -> str:
     Returns one of 'env' (explicit coordinator env vars, incl. torchrun
     style), 'slurm', 'mpi', or 'none' (single process).
     """
+    # 'env' requires a coordinator address: a bare WORLD_SIZE (stale
+    # torchrun/SageMaker ambience) must NOT flip a single-process run into
+    # a hard "missing coordinator" error.
     if _first_env(_COORD_VARS) or os.environ.get("MASTER_ADDR"):
-        return "env"
-    if _first_env(_NPROC_VARS):
         return "env"
     if "SLURM_NTASKS" in os.environ and int(os.environ["SLURM_NTASKS"]) > 1:
         return "slurm"

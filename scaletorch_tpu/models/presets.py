@@ -1,0 +1,136 @@
+"""Named architecture presets for benchmarks and tools.
+
+The reference benchmarks against local HF checkout dirs
+(scripts/benchmark_comprehensive.py:24 MODEL_ROOT + Qwen3-* names); the
+TPU build runs hermetic synthetic-data benchmarks, so the architectures
+are declared here directly (field values match the published HF configs
+for Qwen/Qwen3-*; MoE matches Qwen/Qwen3-30B-A3B).
+
+Each preset is a kwargs dict for ``ScaleTorchTPUArguments`` — pass
+``**preset("qwen3-0.6b")`` plus run-shape fields.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+_QWEN3_COMMON = dict(
+    model_type="qwen3",
+    vocab_size=151936,
+    num_key_value_heads=8,
+    head_dim=128,
+    rope_theta=1e6,
+    rms_norm_eps=1e-6,
+    max_position_embeddings=40960,
+)
+
+MODEL_PRESETS: Dict[str, Dict[str, Any]] = {
+    "qwen3-0.6b": dict(
+        _QWEN3_COMMON,
+        hidden_size=1024,
+        intermediate_size=3072,
+        num_hidden_layers=28,
+        num_attention_heads=16,
+        tie_word_embeddings=True,
+    ),
+    "qwen3-1.7b": dict(
+        _QWEN3_COMMON,
+        hidden_size=2048,
+        intermediate_size=6144,
+        num_hidden_layers=28,
+        num_attention_heads=16,
+        tie_word_embeddings=True,
+    ),
+    "qwen3-4b": dict(
+        _QWEN3_COMMON,
+        hidden_size=2560,
+        intermediate_size=9728,
+        num_hidden_layers=36,
+        num_attention_heads=32,
+        tie_word_embeddings=True,
+    ),
+    "qwen3-8b": dict(
+        _QWEN3_COMMON,
+        hidden_size=4096,
+        intermediate_size=12288,
+        num_hidden_layers=36,
+        num_attention_heads=32,
+        tie_word_embeddings=False,
+    ),
+    "qwen3-14b": dict(
+        _QWEN3_COMMON,
+        hidden_size=5120,
+        intermediate_size=17408,
+        num_hidden_layers=40,
+        num_attention_heads=40,
+        tie_word_embeddings=False,
+    ),
+    "qwen3-32b": dict(
+        _QWEN3_COMMON,
+        hidden_size=5120,
+        intermediate_size=25600,
+        num_hidden_layers=64,
+        num_attention_heads=64,
+        tie_word_embeddings=False,
+    ),
+    # Qwen3-30B-A3B: 128 experts, top-8, 3.3B active of 30.5B total.
+    "qwen3-30b-a3b": dict(
+        model_type="qwen3_moe",
+        vocab_size=151936,
+        hidden_size=2048,
+        intermediate_size=6144,
+        moe_intermediate_size=768,
+        num_hidden_layers=48,
+        num_attention_heads=32,
+        num_key_value_heads=4,
+        head_dim=128,
+        rope_theta=1e6,
+        rms_norm_eps=1e-6,
+        max_position_embeddings=40960,
+        tie_word_embeddings=False,
+        num_experts=128,
+        num_experts_per_tok=8,
+    ),
+    # Downscaled MoE for 8-chip correctness/system sweeps (same shape
+    # family as qwen3-30b-a3b; fits a CPU-device mesh).
+    "moe-tiny": dict(
+        model_type="qwen3_moe",
+        vocab_size=4096,
+        hidden_size=256,
+        intermediate_size=512,
+        moe_intermediate_size=192,
+        num_hidden_layers=4,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        head_dim=32,
+        rope_theta=1e6,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+        num_experts=8,
+        num_experts_per_tok=2,
+    ),
+    # Downscaled dense model for 8-chip correctness/system sweeps.
+    "dense-tiny": dict(
+        model_type="qwen3",
+        vocab_size=4096,
+        hidden_size=256,
+        intermediate_size=512,
+        num_hidden_layers=4,
+        num_attention_heads=8,
+        num_key_value_heads=4,
+        head_dim=32,
+        rope_theta=1e6,
+        max_position_embeddings=8192,
+        tie_word_embeddings=True,
+    ),
+}
+
+
+def preset(name: str) -> Dict[str, Any]:
+    try:
+        return dict(MODEL_PRESETS[name.lower()])
+    except KeyError:
+        raise KeyError(
+            f"unknown model preset {name!r}; available: "
+            f"{', '.join(sorted(MODEL_PRESETS))}"
+        ) from None

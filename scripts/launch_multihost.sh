@@ -39,10 +39,19 @@ SSH_USER="${SSH_USER:-$USER}"
 LOG_DIR="${LOG_DIR:-./multihost_logs/$(date +%Y-%m-%d_%H-%M-%S)}"
 mkdir -p "$LOG_DIR"
 
+LAUNCH_TAG="st_$(date +%s)_$$"
 PIDS=()
 cleanup() {
-    echo "cleaning up remote processes..." >&2
+    echo "cleaning up local ssh + remote processes..." >&2
     for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
+    # The remote trainers survive a dropped ssh connection; kill them by
+    # the PID file each one wrote at startup.
+    for node in "${NODES[@]}"; do
+        ssh -o StrictHostKeyChecking=no -o BatchMode=yes -o ConnectTimeout=5 \
+            "$SSH_USER@$node" \
+            "kill \$(cat /tmp/${LAUNCH_TAG}.pid 2>/dev/null) 2>/dev/null; rm -f /tmp/${LAUNCH_TAG}.pid" \
+            2>/dev/null || true
+    done
 }
 trap cleanup INT TERM
 
@@ -55,6 +64,7 @@ for i in "${!NODES[@]}"; do
         export JAX_COORDINATOR_ADDRESS='$COORD_ADDR'
         export JAX_NUM_PROCESSES='$NUM_NODES'
         export JAX_PROCESS_ID='$i'
+        echo \$\$ > /tmp/${LAUNCH_TAG}.pid
         exec $*
     " > "$log" 2>&1 &
     PIDS+=($!)

@@ -118,6 +118,12 @@ def test_infer_launcher_env_styles(monkeypatch):
     monkeypatch.delenv("MASTER_ADDR")
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
     assert infer_launcher() == "env"
+    # A bare WORLD_SIZE without a coordinator address (stale torchrun /
+    # SageMaker ambience) must stay single-process, not error out.
+    monkeypatch.delenv("JAX_COORDINATOR_ADDRESS")
+    monkeypatch.delenv("OMPI_COMM_WORLD_SIZE")
+    monkeypatch.setenv("WORLD_SIZE", "8")
+    assert infer_launcher() == "none"
 
 
 @pytest.mark.slow

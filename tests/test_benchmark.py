@@ -1,0 +1,49 @@
+"""Benchmark library + presets (scaletorch_tpu/benchmark.py).
+
+The reference's sweep correctness is untested; here the in-process
+runner used by bench.py and scripts/benchmark_comprehensive.py is
+exercised on the virtual 8-device mesh.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
+from scaletorch_tpu.models.presets import MODEL_PRESETS, preset
+
+
+def test_presets_known_architectures():
+    p = preset("qwen3-0.6b")
+    assert p["hidden_size"] == 1024 and p["num_hidden_layers"] == 28
+    moe = preset("qwen3-30b-a3b")
+    assert moe["num_experts"] == 128 and moe["num_experts_per_tok"] == 8
+    with pytest.raises(KeyError, match="unknown model preset"):
+        preset("nope")
+    # preset() hands out copies — mutating one must not poison the table
+    p["hidden_size"] = 1
+    assert preset("qwen3-0.6b")["hidden_size"] == 1024
+
+
+def test_make_bench_args_shapes():
+    cfg = make_bench_args("qwen3-0.6b", seq=4096, micro_bs=2, gc=True, tp=1)
+    assert cfg.sequence_length == 4096
+    assert cfg.micro_batch_size == 2
+    assert cfg.gradient_checkpointing is True
+    assert cfg.synthetic_data is True
+
+
+@pytest.mark.parametrize("name", sorted(MODEL_PRESETS))
+def test_all_presets_build_valid_configs(name):
+    make_bench_args(name, seq=256)
+
+
+def test_benchmark_config_runs_on_mesh(devices8):
+    cfg = make_bench_args(
+        "dense-tiny", seq=128, dp=8, micro_bs=1, dtype="float32",
+    )
+    r = benchmark_config(cfg, warmup=1, steps=2)
+    assert r["num_chips"] == 8
+    assert r["tokens_per_second"] > 0
+    assert r["loss"] == pytest.approx(8.3, abs=0.5)  # ~ln(4096) at init
+    assert r["mfu"] > 0
