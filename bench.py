@@ -116,6 +116,11 @@ def main() -> None:
     warmup = int(os.environ.get("BENCH_WARMUP_STEPS", 3))
     steps = int(os.environ.get("BENCH_STEPS", 10))
 
+    unknown = [a for a in sys.argv[1:] if a != "--table"]
+    if unknown:
+        raise SystemExit(f"unknown arguments {unknown}; supported: --table "
+                         "(other knobs via BENCH_* env vars)")
+
     if "--table" in sys.argv:
         # One subprocess per row: isolates OOMs and keeps per-row device
         # memory peaks meaningful (peak_bytes_in_use is a process-lifetime
@@ -149,9 +154,6 @@ def main() -> None:
             sys.exit(1)
         print(json.dumps(head))
         return
-
-    if any(a.startswith("-") for a in sys.argv[1:]):
-        raise SystemExit(f"unknown arguments {sys.argv[1:]}; supported: --table")
 
     label = os.environ.get("BENCH_ROW", HEADLINE)
     if label not in SINGLE_CHIP_ROWS:

@@ -55,7 +55,6 @@ def benchmark_config(cfg, warmup: int = 3, steps: int = 10) -> Dict[str, Any]:
         final_loss = float(m["loss"])
         jax.block_until_ready(trainer.params)
         elapsed = time.perf_counter() - t0
-        last = {"loss": final_loss}
 
         tok_s = trainer.loader.tokens_per_step * steps / elapsed
         num_chips = len(jax.devices())
@@ -77,7 +76,7 @@ def benchmark_config(cfg, warmup: int = 3, steps: int = 10) -> Dict[str, Any]:
             "tokens_per_second": round(tok_s, 1),
             "tokens_per_second_per_chip": round(tok_s / num_chips, 1),
             "mfu": round(mfu, 2),
-            "loss": round(float(last.get("loss", 0.0)), 4) if last else None,
+            "loss": round(final_loss, 4),
             "step_time_s": round(elapsed / steps, 4),
             "memory_gb": round(mem["peak_bytes_in_use"] / 1e9, 2)
             if mem.get("peak_bytes_in_use")

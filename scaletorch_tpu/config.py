@@ -101,8 +101,19 @@ class ParallelArguments:
     pipeline_parallel_size: int = field(default=1, metadata={"help": "PP degree."})
     context_parallel_size: int = field(default=1, metadata={"help": "CP degree."})
     expert_parallel_size: int = field(default=1, metadata={"help": "EP degree."})
+    # Default differs from the reference (pipeline_parallel_engine='1f1b',
+    # config.py:155-173) BY MEASUREMENT: in the SPMD design afab already
+    # has 1F1B's bubble fraction and is ~1.25x faster than the chunked
+    # memory-bounded schedule — see tools/pp_schedule_compare.py.
     pp_engine: str = field(
-        default="1f1b", metadata={"help": "Pipeline schedule: 1f1b | afab."}
+        default="afab",
+        metadata={"help": "Pipeline schedule: 'afab' = one fwd+bwd SPMD "
+                          "pipeline (1F1B-equivalent bubble (pp-1)/(accum+pp-1), "
+                          "O(accum) boundary-activation memory); '1f1b' = "
+                          "memory-bounded chunked accumulation (1F1B's O(pp) "
+                          "boundary memory, ~1.25x slower at pp4/accum8 — "
+                          "measured by tools/pp_schedule_compare.py). Prefer "
+                          "afab unless activation memory binds."},
     )
     sequence_parallel: bool = field(
         default=False, metadata={"help": "Megatron-style SP over the tp axis."}

@@ -198,6 +198,52 @@ def moe_block(
     return x + y.astype(x.dtype), aux_total, stats
 
 
+def moe_decoder_stack(
+    x: jax.Array,
+    layers: Params,
+    cos: jax.Array,
+    sin: jax.Array,
+    cfg: Qwen3MoEConfig,
+    attn_fn: Callable,
+    helpers,
+    *,
+    tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
+    gradient_checkpointing: bool = False,
+    remat_policy: str = "nothing_saveable",
+) -> Tuple[jax.Array, jax.Array, dict]:
+    """Scan attention+MoE layers over a stacked layer block; returns
+    (hidden, aux_loss_sum, stats_layer_mean). The MoE counterpart of
+    llama.decoder_stack, shared by the full forward and by one pipeline
+    stage's compute (where ``layers`` is the pp-sharded [L/pp, ...] block)."""
+    extra = tuple(a for a in (tp_axis, ep_axis) if a)
+    x = pvary_missing(x, extra) if extra else x
+
+    def layer_body(h, layer_params):
+        h = _llama.attention_block(h, layer_params, cos, sin, cfg, attn_fn,
+                                   helpers)
+        h, aux, stats = moe_block(
+            h, layer_params, cfg, helpers,
+            ep_axis=ep_axis, tp_axis=tp_axis,
+            sequence_parallel=sequence_parallel,
+        )
+        if extra:
+            h, aux = pvary_missing(h, extra), pvary_missing(aux, extra)
+            stats = jax.tree.map(lambda v: pvary_missing(v, extra), stats)
+        return h, (aux, stats)
+
+    if gradient_checkpointing:
+        layer_body = jax.checkpoint(
+            layer_body, policy=_llama.resolve_remat_policy(remat_policy)
+        )
+
+    x, (aux_per_layer, stats_per_layer) = jax.lax.scan(layer_body, x, layers)
+    aux_loss = jnp.sum(aux_per_layer)
+    moe_stats = jax.tree.map(lambda v: jnp.mean(v, axis=0), stats_per_layer)
+    return x, aux_loss, moe_stats
+
+
 def forward(
     params: Params,
     input_ids: jax.Array,
@@ -229,36 +275,17 @@ def forward(
     attn_fn = get_attention_backend(attention_backend)
     helpers = _llama.tp_region_helpers(cfg, tp_axis, sequence_parallel)
 
-    # Keep the scan carry's varying-axis set stable: the MoE combine
-    # einsum re-marks the residual as varying over tp (the combine weights
-    # come from the tp-varied router), so pin both the initial carry and
-    # the per-layer outputs to the same vma.
-    extra = tuple(a for a in (tp_axis, ep_axis) if a)
-    x = pvary_missing(x, extra) if extra else x
-
-    def layer_body(h, layer_params):
-        h = _llama.attention_block(h, layer_params, cos, sin, cfg, attn_fn,
-                                   helpers)
-        h, aux, stats = moe_block(
-            h, layer_params, cfg, helpers,
-            ep_axis=ep_axis, tp_axis=tp_axis,
-            sequence_parallel=sequence_parallel,
-        )
-        if extra:
-            h, aux = pvary_missing(h, extra), pvary_missing(aux, extra)
-            stats = jax.tree.map(lambda v: pvary_missing(v, extra), stats)
-        return h, (aux, stats)
-
-    if gradient_checkpointing:
-        layer_body = jax.checkpoint(
-            layer_body, policy=_llama.resolve_remat_policy(remat_policy)
-        )
-
-    x, (aux_per_layer, stats_per_layer) = jax.lax.scan(
-        layer_body, x, params["layers"]
+    # moe_decoder_stack keeps the scan carry's varying-axis set stable:
+    # the MoE combine einsum re-marks the residual as varying over tp (the
+    # combine weights come from the tp-varied router), so it pins both the
+    # initial carry and the per-layer outputs to the same vma.
+    x, aux_loss, moe_stats = moe_decoder_stack(
+        x, params["layers"], cos, sin, cfg, attn_fn, helpers,
+        tp_axis=tp_axis, ep_axis=ep_axis,
+        sequence_parallel=sequence_parallel,
+        gradient_checkpointing=gradient_checkpointing,
+        remat_policy=remat_policy,
     )
-    aux_loss = jnp.sum(aux_per_layer)
-    moe_stats = jax.tree.map(lambda v: jnp.mean(v, axis=0), stats_per_layer)
 
     x = _llama.final_hidden(params, x, cfg, tp_axis=tp_axis,
                             sequence_parallel=sequence_parallel)

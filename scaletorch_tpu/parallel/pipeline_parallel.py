@@ -29,6 +29,13 @@ pp_comms.py:86-286 blocking P2P), re-designed TPU-first:
     in-flight activations at O(pp) exactly like 1F1B's steady state
     (reference warmup = pp - rank - 1, pipeline_parallel.py:457-671); the
     price is a bubble per chunk rather than per step.
+  * Schedule accounting (measured, tools/pp_schedule_compare.py): under
+    SPMD every stage ticks in lockstep, so ``afab``'s fwd+bwd pipelines
+    cost 2(M+pp-1) ticks — bubble fraction (pp-1)/(M+pp-1), the SAME as
+    textbook 1F1B; MPMD-style F/B interleaving would cost M+2(pp-1)
+    combined ticks, i.e. strictly more here. 1F1B's remaining advantage
+    is memory, which ``1f1b`` provides: measured 1.25x slower than afab
+    at pp=4/accum=8 (predicted 1.27x from tick counts).
 
 ``stage_layer_partition`` keeps the reference's uneven-layer bookkeeping
 (pipeline_parallel.py:83-133) for checkpoint naming and HF-weight loading;
@@ -44,6 +51,11 @@ import jax.numpy as jnp
 
 from scaletorch_tpu.parallel.mesh import MeshManager
 from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+
+# Scalar routing-health stats the MoE pipeline emits per stage; shared with
+# the spmd step's chunked-schedule accumulator so both schedules report the
+# same metric set.
+MOE_PIPELINE_STATS: tuple[str, ...] = ("moe_dropped_fraction", "moe_load_cv")
 
 
 def stage_layer_partition(
@@ -107,7 +119,9 @@ def pipeline_spmd_loss(
     all_axes: Sequence[str] = ("dp", "cp", "ep", "tp", "pp"),
     remat_ticks: bool = True,
     carry_seq_divisor: int = 1,
-) -> jax.Array:
+    stage_returns_aux: bool = False,
+    stats_template: Optional[Sequence[str]] = None,
+) -> Any:
     """Mean loss over M microbatches through the pp-stage pipeline.
 
     Must run inside a shard_map over a mesh containing ``pp_axis``, with
@@ -120,6 +134,13 @@ def pipeline_spmd_loss(
     ``embed_fn(params, ids) -> x``        first-stage entry ([B, S', H])
     ``stage_fn(params, x, pos) -> x``     this stage's layer stack
     ``loss_fn(params, x, targets) -> l``  last-stage epilogue (norm+head+CE)
+
+    With ``stage_returns_aux`` (the MoE pipeline), ``stage_fn`` instead
+    returns ``(x, aux_scalar, stats_dict)``: per-tick aux losses are
+    accumulated only over each stage's LIVE ticks (tick t is live on stage
+    s iff s <= t < s + M — padding ticks route zero tokens and their aux
+    must not pollute the loss), psum'd over pp and folded into the
+    returned loss; the call then returns ``(loss, stats_mean)``.
 
     Numerical-safety invariant: ticks outside a stage's live window and
     non-last-stage loss inputs are zeros, never garbage, so no NaN/Inf can
@@ -146,10 +167,12 @@ def pipeline_spmd_loss(
     pos_p = pvary_missing(pos_p, axes)
 
     fwd_pairs = [(i, i + 1) for i in range(pp_size - 1)]
+    ticks_iota = pvary_missing(jnp.arange(m + pad, dtype=jnp.int32), axes)
+    zero = pvary_missing(jnp.float32(0.0), axes)
 
     def tick(carry, xs):
-        x, pos = carry
-        ids_t, pos_t = xs
+        x, pos, aux_acc, stats_acc = carry
+        ids_t, pos_t, t = xs
         if pp_size > 1:
             # Stage s hands its activation (and the microbatch's positions,
             # which RoPE needs at EVERY stage — stage s is processing
@@ -159,20 +182,37 @@ def pipeline_spmd_loss(
         emb = pvary_missing(embed_fn(params, ids_t), axes)
         x = jnp.where(is_first, emb, x)
         pos = jnp.where(is_first, pos_t, pos)
-        x = stage_fn(params, x, pos)
+        if stage_returns_aux:
+            x, aux, stats = stage_fn(params, x, pos)
+            live = (t >= stage) & (t < stage + m)
+            aux_acc = aux_acc + jnp.where(live, pvary_missing(aux, axes), 0.0)
+            stats_acc = jax.tree.map(
+                lambda acc, v: acc + jnp.where(
+                    live, pvary_missing(v, axes), 0.0),
+                stats_acc, stats,
+            )
+        else:
+            x = stage_fn(params, x, pos)
         # Re-vary to the full axis set: stage_fn's trailing psum (row-
         # parallel all-reduce) drops 'tp' from the vma; the carry must have
         # a fixed vma across scan iterations. The pvary transpose is the
         # per-layer f-function backward all-reduce the reference also pays
         # (tp_comms.py:64-114).
-        return (pvary_missing(x, axes), pos), x
+        return (pvary_missing(x, axes), pos, aux_acc, stats_acc), x
 
     if remat_ticks:
         tick = jax.checkpoint(tick)
 
+    # Accumulator structure must be known statically; stats_template names
+    # the scalar stats stage_fn emits (collectives inside stage_fn rule
+    # out probing it by abstract eval here).
+    stats0 = {k: zero for k in (stats_template or ())}
+
     x0 = pvary_missing(jnp.zeros(carry_shape, model_cfg.dtype), axes)
     pos0 = pvary_missing(jnp.zeros((s,), pos.dtype), axes)
-    _, ys = jax.lax.scan(tick, (x0, pos0), (ids_p, pos_p))
+    (_, _, aux_acc, stats_acc), ys = jax.lax.scan(
+        tick, (x0, pos0, zero, stats0), (ids_p, pos_p, ticks_iota)
+    )
     outs = ys[pad:]  # [M, B, S', H]; meaningful only on the last stage
 
     # Zero-sanitise before the head so non-last stages compute a finite
@@ -185,13 +225,22 @@ def pipeline_spmd_loss(
         x_m, t_m = xm_tm
         return acc + pvary_missing(loss_fn(params, x_m, t_m), axes), None
 
-    zero = pvary_missing(jnp.float32(0.0), axes)
     tgt_v = pvary_missing(tgt, axes)
     loss_sum, _ = jax.lax.scan(mb_loss, zero, (outs, tgt_v))
-    loss = loss_sum / m
-    # Only the last stage computed a real loss; broadcast it to all stages
-    # (every rank needs the same cotangent seed for its local params).
-    return jax.lax.psum(jnp.where(is_last, loss, jnp.zeros_like(loss)), pp_axis)
+    # Only the last stage computed a real CE; each stage contributes its
+    # own live-tick aux sum. One psum over pp broadcasts the combined loss
+    # to all stages (every rank needs the same cotangent seed for its
+    # local params).
+    ce_part = jnp.where(is_last, loss_sum, jnp.zeros_like(loss_sum))
+    loss = jax.lax.psum(ce_part + aux_acc, pp_axis) / m
+    if not stage_returns_aux:
+        return loss
+    # Stats: per-stage layer-means over live ticks -> mean over
+    # microbatches and stages.
+    stats = jax.tree.map(
+        lambda v: jax.lax.psum(v, pp_axis) / (m * pp_size), stats_acc
+    )
+    return loss, stats
 
 
 def make_llama_pipeline_loss(
@@ -252,6 +301,79 @@ def make_llama_pipeline_loss(
             pp_size=mm.pp, embed_fn=embed_fn, stage_fn=stage_fn,
             loss_fn=loss_fn, pp_axis=pp_axis,
             carry_seq_divisor=mm.tp if sp else 1,
+        )
+
+    return pipeline_loss
+
+
+def make_moe_pipeline_loss(
+    mm: MeshManager,
+    model_cfg,
+    *,
+    attention_backend: str = "sdpa",
+    gradient_checkpointing: bool = False,
+    remat_policy: str = "nothing_saveable",
+    sequence_parallel: bool = False,
+    tp_axis: Optional[str] = "tp",
+    ep_axis: Optional[str] = "ep",
+    pp_axis: str = "pp",
+    head_weight_fn: Optional[Callable] = None,
+) -> Callable:
+    """Bind the Qwen3-MoE pieces into a pipeline loss
+    ``(params, batch) -> (loss, moe_stats)`` — PP x EP composition.
+
+    The reference runs its model-generic MPMD pipeline over MoE stages
+    with per-rank aux-loss stashes collected after the schedule
+    (pipeline_parallel.py:30-178 + model_qwen3_moe.py:375-381); here each
+    stage's live-tick aux rides the scan carry and one pp-psum folds it
+    into the loss (pipeline_spmd_loss stage_returns_aux).
+    """
+    from scaletorch_tpu.models import llama, qwen3_moe
+    from scaletorch_tpu.models.layers import get_cos_sin
+    from scaletorch_tpu.models.registry import get_attention_backend
+    from scaletorch_tpu.parallel.tensor_parallel import (
+        fused_vocab_parallel_cross_entropy,
+    )
+
+    validate_pp_divisibility(model_cfg, mm.pp)
+    attn_fn = get_attention_backend(attention_backend)
+    if head_weight_fn is None:
+        head_weight_fn = qwen3_moe.lm_head_weight
+    tp = tp_axis if mm.tp > 1 else None
+    ep = ep_axis if mm.ep > 1 else None
+    sp = sequence_parallel and mm.tp > 1
+    helpers = llama.tp_region_helpers(model_cfg, tp, sp)
+
+    def embed_fn(params, ids_t):
+        return llama.embed(params, ids_t, model_cfg, tp_axis=tp,
+                           sequence_parallel=sp)
+
+    def stage_fn(params, x, pos_t):
+        cos, sin = get_cos_sin(
+            pos_t.shape[0], model_cfg.actual_head_dim, model_cfg.rope_theta,
+            positions=pos_t,
+        )
+        return qwen3_moe.moe_decoder_stack(
+            x, params["layers"], cos, sin, model_cfg, attn_fn, helpers,
+            tp_axis=tp, ep_axis=ep, sequence_parallel=sp,
+            gradient_checkpointing=gradient_checkpointing,
+            remat_policy=remat_policy,
+        )
+
+    def loss_fn(params, x_m, t_m):
+        x_m = llama.final_hidden(params, x_m, model_cfg, tp_axis=tp,
+                                 sequence_parallel=sp)
+        head = head_weight_fn(params, model_cfg, tp)
+        return fused_vocab_parallel_cross_entropy(x_m, head, t_m, axis=tp)
+
+    def pipeline_loss(params, batch):
+        return pipeline_spmd_loss(
+            params, batch, model_cfg,
+            pp_size=mm.pp, embed_fn=embed_fn, stage_fn=stage_fn,
+            loss_fn=loss_fn, pp_axis=pp_axis,
+            carry_seq_divisor=mm.tp if sp else 1,
+            stage_returns_aux=True,
+            stats_template=MOE_PIPELINE_STATS,
         )
 
     return pipeline_loss

@@ -186,6 +186,7 @@ class Trainer:
                 self.model_cfg,
                 tp_axis="tp",
                 ep_axis="ep" if cfg.expert_parallel_size > 1 else None,
+                pp_axis="pp" if cfg.pipeline_parallel_size > 1 else None,
             )
             model_kwargs = {
                 "ep_axis": "ep" if cfg.expert_parallel_size > 1 else None,
@@ -235,6 +236,7 @@ class Trainer:
             param_specs=param_specs,
             model_kwargs=model_kwargs,
             head_weight_fn=head_weight_fn,
+            model_family="qwen3_moe" if is_moe else "llama",
         )
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)

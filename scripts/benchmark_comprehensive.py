@@ -72,6 +72,9 @@ CORRECTNESS_CONFIGS = [
     ("moe-EP2-TP2-DP2",      "moe-tiny",   2, 1, 2, 1, 2, 1, 1, 256, False, False, "1f1b"),
     ("moe-EP2-CP2-DP2",      "moe-tiny",   1, 1, 2, 2, 2, 1, 1, 512, False, False, "1f1b"),
     ("moe-EP2-TP2-CP2-GC",   "moe-tiny",   2, 1, 1, 2, 2, 1, 1, 512, True,  False, "1f1b"),
+    # --- PP x EP (MoE pipeline; VERDICT r1 missing #8) ---
+    ("moe-PP2-EP2-DP2",      "moe-tiny",   1, 2, 2, 1, 2, 1, 2, 256, False, False, "afab"),
+    ("moe-PP2-EP2-TP2-1f1b", "moe-tiny",   2, 2, 1, 1, 2, 1, 2, 256, False, False, "1f1b"),
 ]
 
 # The reference's published 8-chip rows (BASELINE.md §8-NPU) + single-chip

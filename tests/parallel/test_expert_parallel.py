@@ -330,3 +330,126 @@ class TestMoETrainStep:
             lambda a, b: float(jnp.max(jnp.abs(jnp.asarray(a) - b))), p2, params
         )
         assert max(jax.tree.leaves(delta)) > 0
+
+
+class TestMoEPipeline:
+    """PP x EP composition (VERDICT r1 missing #8): the MoE pipeline loss
+    and one-step update must match the single-device MoE step."""
+
+    def _batch(self, accum=2, rows=4, seq=16):
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, CFG.vocab_size, (accum, rows, seq + 1))
+        return {
+            "input_ids": toks[:, :, :-1].astype(np.int32),
+            "target_ids": toks[:, :, 1:].astype(np.int32),
+            "position_ids": np.broadcast_to(
+                np.arange(seq, dtype=np.int32), (accum, seq)
+            ).copy(),
+        }
+
+    def _ref_loss(self, params, batch):
+        """Single-device mean over microbatches of (CE + aux) — the SPMD
+        step's exact loss form."""
+        from scaletorch_tpu.models.qwen3_moe import lm_head_weight
+        from scaletorch_tpu.parallel.tensor_parallel import (
+            fused_vocab_parallel_cross_entropy,
+        )
+
+        seq = batch["input_ids"].shape[-1]
+        pos = jnp.arange(seq, dtype=jnp.int32)
+
+        def one(p, ids, tgt):
+            hidden, aux = forward(p, ids, CFG, positions=pos,
+                                  return_hidden=True)
+            head = lm_head_weight(p, CFG, None)
+            ce = fused_vocab_parallel_cross_entropy(hidden, head, tgt,
+                                                    axis=None)
+            return ce + aux
+
+        def loss(p):
+            losses = [
+                one(p, jnp.asarray(batch["input_ids"][m]),
+                    jnp.asarray(batch["target_ids"][m]))
+                for m in range(batch["input_ids"].shape[0])
+            ]
+            return sum(losses) / len(losses)
+
+        return loss
+
+    def test_pp_ep_update_matches_single_device(self):
+        import optax
+
+        from scaletorch_tpu.config import ScaleTorchTPUArguments
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
+        from scaletorch_tpu.trainer.optimizer import create_optimizer
+
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        batch = self._batch()
+        ref_loss = self._ref_loss(params, batch)
+
+        tcfg = ScaleTorchTPUArguments(
+            learning_rate=1e-2, total_train_steps=10, warmup_steps=0,
+            optimizer_name="sgd",
+        )
+        tx, _ = create_optimizer(tcfg, include_clip=False)
+        grads_ref = jax.grad(ref_loss)(params)
+        updates, _ = tx.update(grads_ref, tx.init(params), params)
+        p_ref = optax.apply_updates(params, updates)
+
+        mm = MeshManager(pp=2, ep=2, dp=2)
+        specs = qwen3_moe_param_specs(CFG, tp_axis="tp", ep_axis="ep",
+                                      pp_axis="pp")
+        step_fn, p_specs, o_specs = make_spmd_train_step(
+            mm, forward, CFG, tx, params,
+            donate=False, param_specs=specs,
+            model_kwargs={"ep_axis": "ep"},
+            model_family="qwen3_moe", pp_schedule="afab",
+        )
+        p2, _, metrics = step_fn(
+            shard_params(mm, params, p_specs),
+            shard_params(mm, tx.init(params), o_specs),
+            batch,
+        )
+        assert float(metrics["loss"]) == pytest.approx(
+            float(ref_loss(params)), rel=1e-5
+        )
+        # routing health stats flow through the pipeline too
+        assert 0.0 <= float(metrics["moe_dropped_fraction"]) <= 1.0
+        assert float(metrics["moe_load_cv"]) >= 0.0
+        for a, b in zip(jax.tree.leaves(p_ref),
+                        jax.tree.leaves(jax.device_get(p2))):
+            np.testing.assert_allclose(a, b, atol=3e-5)
+
+    @pytest.mark.parametrize("schedule", ["afab", "1f1b"])
+    def test_spmd_step_pp_ep_tp(self, schedule):
+        from scaletorch_tpu.config import ScaleTorchTPUArguments
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
+        from scaletorch_tpu.trainer.optimizer import create_optimizer
+
+        mm = MeshManager(pp=2, ep=2, tp=2)
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        tcfg = ScaleTorchTPUArguments(
+            learning_rate=1e-3, total_train_steps=10, warmup_steps=0
+        )
+        tx, _ = create_optimizer(tcfg, include_clip=False)
+        specs = qwen3_moe_param_specs(CFG, tp_axis="tp", ep_axis="ep",
+                                      pp_axis="pp")
+        step_fn, p_specs, o_specs = make_spmd_train_step(
+            mm, forward, CFG, tx, params,
+            max_grad_norm=1.0, donate=False, param_specs=specs,
+            model_kwargs={"ep_axis": "ep"},
+            model_family="qwen3_moe", pp_schedule=schedule,
+        )
+        batch = self._batch(accum=2, rows=2)
+        p2, o2, metrics = step_fn(
+            shard_params(mm, params, p_specs),
+            shard_params(mm, tx.init(params), o_specs),
+            batch,
+        )
+        assert np.isfinite(float(metrics["loss"]))
+        assert np.isfinite(float(metrics["grad_norm"]))
+        assert 0.0 <= float(metrics["moe_dropped_fraction"]) <= 1.0
+        delta = jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(jnp.asarray(a) - b))), p2, params
+        )
+        assert max(jax.tree.leaves(delta)) > 0
