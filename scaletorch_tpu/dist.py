@@ -200,6 +200,12 @@ def put_global(host_array, sharding) -> jax.Array:
     the multi-host feeding path the reference implements with per-rank
     sampler offsets (dataloader.py:170-233).
     """
+    if isinstance(host_array, jax.Array) and not host_array.is_fully_addressable:
+        # Already a global multi-process array (e.g. from the streamed HF
+        # loader): fetching it to host would crash — and defeat the point.
+        if host_array.sharding == sharding:
+            return host_array
+        return jax.device_put(host_array, sharding)
     if jax.process_count() == 1:
         return jax.device_put(host_array, sharding)
     host_array = np.asarray(host_array)

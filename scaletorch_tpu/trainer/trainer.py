@@ -205,12 +205,36 @@ class Trainer:
                 raise ValueError(
                     "load_pretrained_weights requires model_name_or_path"
                 )
+            from jax.sharding import PartitionSpec
+
             from scaletorch_tpu.utils.hf_interop import load_hf_params
 
-            # Assembled on host, distributed to the mesh sharding below via
-            # shard_params (reference materialization path,
-            # checkpoint.py:64-142).
-            params_host = load_hf_params(cfg.model_name_or_path, self.model_cfg)
+            # Streamed load straight into the mesh shardings: each process
+            # reads only the checkpoint slices its shards need, one layer
+            # at a time — host memory stays bounded by one layer even for
+            # 30B-class models (reference per-stage/per-rank subset
+            # loading, checkpoint.py:265-423).
+            if param_specs is not None:
+                specs_for_load = param_specs
+            else:
+                from scaletorch_tpu.parallel.tensor_parallel import (
+                    llama_param_specs,
+                )
+
+                specs_for_load = llama_param_specs(
+                    self.model_cfg,
+                    tp_axis="tp",
+                    pp_axis="pp" if cfg.pipeline_parallel_size > 1 else None,
+                )
+            load_shardings = jax.tree.map(
+                lambda s: NamedSharding(self.mm.mesh, s),
+                specs_for_load,
+                is_leaf=lambda x: isinstance(x, PartitionSpec),
+            )
+            params_host = load_hf_params(
+                cfg.model_name_or_path, self.model_cfg,
+                shardings=load_shardings,
+            )
         else:
             # local_devices: under multi-process, jax.devices()[0] may belong
             # to another host and its arrays would be unreadable here.

@@ -120,6 +120,29 @@ def _open_shards(path: str):
     return tensors, handles
 
 
+def _debf16(t: np.ndarray) -> np.ndarray:
+    """safetensors' numpy framework hands raw bf16 back as void16; re-view
+    through jnp.bfloat16 and widen to fp32 for host-side math."""
+    if t.dtype == np.dtype("V2"):
+        t = jnp.asarray(t.view(np.uint16)).view(jnp.bfloat16)
+        t = np.asarray(t.astype(jnp.float32))
+    return t
+
+
+def _read_hf_slice(handle, name: str, idx: tuple, transpose: bool) -> np.ndarray:
+    """Read ONLY ``idx`` (tuple of slices in OUR dim order) of one HF
+    tensor — the unit of host memory the streamed loader materialises.
+    safetensors' lazy ``get_slice`` reads just the requested byte ranges
+    (the role of the reference's per-rank adjust_tensor_size slicing,
+    checkpoint.py:339-423)."""
+    sl = handle.get_slice(name)
+    if transpose:  # our [in, out] view of an HF [out, in] tensor
+        idx = tuple(reversed(idx))
+    t = np.asarray(sl[idx] if idx else sl[:])
+    t = _debf16(t)
+    return t.T if transpose else t
+
+
 def load_hf_params(
     path: str,
     cfg,
@@ -131,15 +154,24 @@ def load_hf_params(
     stacked param tree.
 
     ``shardings``: optional pytree of NamedSharding matching the param
-    tree — each assembled global array is device_put straight into its
-    sharding (the TP/PP/EP distribution the reference does by per-rank
-    slicing on load). Missing lm_head with tie_word_embeddings=True is
-    fine (tied head reads the embedding; reference
-    _handle_final_projection, checkpoint.py:223-251).
+    tree. When given, loading is STREAMED: each process materialises only
+    the slices its addressable shards need, one layer/expert tensor at a
+    time (``jax.make_array_from_callback`` + lazy safetensors slicing), so
+    peak host memory is bounded by one layer regardless of model size —
+    the reference's per-PP-stage/EP-rank subset loading
+    (checkpoint.py:265-423) without the rank bookkeeping. Without
+    shardings the whole tree is assembled on host (small models, tests).
+
+    Missing lm_head with tie_word_embeddings=True is fine (tied head
+    reads the embedding; reference _handle_final_projection,
+    checkpoint.py:223-251).
     """
+    if shardings is not None:
+        return _load_hf_params_streamed(
+            path, cfg, shardings, param_dtype=param_dtype
+        )
     pd = param_dtype or cfg.param_dtype
     tensors, handles = _open_shards(path)
-    is_moe = hasattr(cfg, "num_experts")
 
     def get(name: str) -> np.ndarray:
         if name not in tensors:
@@ -150,29 +182,12 @@ def load_hf_params(
         return tensors[name].get_tensor(name)
 
     def fetch(template: str, transpose: bool, **fmt) -> np.ndarray:
-        t = get(template.format(**fmt))
-        t = np.asarray(t)
-        if t.dtype == np.dtype("V2"):  # raw bf16 comes out as void16
-            t = t.view(np.uint16)
-            t = jnp.asarray(t).view(jnp.bfloat16)
-            t = np.asarray(t.astype(jnp.float32))
+        t = _debf16(np.asarray(get(template.format(**fmt))))
         return t.T if transpose else t
 
     l = cfg.num_hidden_layers
     layers: Params = {}
-    layer_keys = [
-        "input_layernorm", "q_proj", "k_proj", "v_proj", "o_proj",
-        "post_attention_layernorm",
-    ]
-    if getattr(cfg, "qk_norm", False):
-        layer_keys += ["q_norm", "k_norm"]
-    if is_moe:
-        layer_keys += ["router", "expert_gate_proj", "expert_up_proj",
-                       "expert_down_proj"]
-    else:
-        layer_keys += ["gate_proj", "up_proj", "down_proj"]
-
-    for key in layer_keys:
+    for key in _layer_keys_for(cfg):
         template, transpose = _LAYER_MAP[key]
         if "{e}" in template:
             stacked = np.stack([
@@ -216,45 +231,222 @@ def load_hf_params(
         if close:
             close()
 
-    if shardings is not None:
-        params = jax.tree.map(
-            lambda x, s: jax.device_put(jnp.asarray(x), s), params, shardings
-        )
+    return jax.tree.map(jnp.asarray, params)
+
+
+def _layer_keys_for(cfg) -> list:
+    keys = [
+        "input_layernorm", "q_proj", "k_proj", "v_proj", "o_proj",
+        "post_attention_layernorm",
+    ]
+    if getattr(cfg, "qk_norm", False):
+        keys += ["q_norm", "k_norm"]
+    if hasattr(cfg, "num_experts"):
+        keys += ["router", "expert_gate_proj", "expert_up_proj",
+                 "expert_down_proj"]
     else:
-        params = jax.tree.map(jnp.asarray, params)
+        keys += ["gate_proj", "up_proj", "down_proj"]
+    return keys
+
+
+def _load_hf_params_streamed(
+    path: str, cfg, shardings: Any, *, param_dtype: Optional[Any] = None
+) -> Params:
+    """Bounded-host-memory load: every leaf is built shard-by-shard via
+    jax.make_array_from_callback; the callback reads exactly the layer
+    range / expert range / tensor slice one device needs."""
+    pd = param_dtype or cfg.param_dtype
+    tensors, handles = _open_shards(path)
+
+    def handle_for(name: str):
+        if name not in tensors:
+            raise KeyError(
+                f"{name} not found in checkpoint at {path} "
+                f"({len(tensors)} tensors present)"
+            )
+        return tensors[name]
+
+    def leaf_from_callback(shape, sharding, cb):
+        return jax.make_array_from_callback(
+            shape, sharding, lambda idx: cb(idx).astype(pd)
+        )
+
+    def flat_cb(template: str, transpose: bool):
+        name = template
+        return lambda idx: _read_hf_slice(handle_for(name), name, idx, transpose)
+
+    def stacked_cb(template: str, transpose: bool):
+        """[L, *inner] leaf: idx[0] selects this shard's layer block."""
+        def cb(idx):
+            lsl, inner = idx[0], tuple(idx[1:])
+            parts = [
+                _read_hf_slice(
+                    handle_for(template.format(i=i)),
+                    template.format(i=i), inner, transpose,
+                )
+                for i in range(*lsl.indices(cfg.num_hidden_layers))
+            ]
+            return np.stack(parts)
+        return cb
+
+    def expert_cb(template: str, transpose: bool):
+        """[L, E, *inner] leaf: layer AND expert ranges per shard."""
+        def cb(idx):
+            lsl, esl, inner = idx[0], idx[1], tuple(idx[2:])
+            return np.stack([
+                np.stack([
+                    _read_hf_slice(
+                        handle_for(template.format(i=i, e=e)),
+                        template.format(i=i, e=e), inner, transpose,
+                    )
+                    for e in range(*esl.indices(cfg.num_experts))
+                ])
+                for i in range(*lsl.indices(cfg.num_hidden_layers))
+            ])
+        return cb
+
+    # Global leaf shapes straight from the initializer's abstract eval —
+    # guaranteed to match the training param tree.
+    from scaletorch_tpu.models import llama as _llama
+
+    if hasattr(cfg, "num_experts"):
+        from scaletorch_tpu.models import qwen3_moe as _family
+    else:
+        _family = _llama
+    shapes = jax.eval_shape(lambda: _family.init_params(jax.random.key(0), cfg))
+
+    params: Params = {"layers": {}}
+    for key in ("embed_tokens", "norm", "lm_head"):
+        if key not in shapes:
+            continue
+        template, transpose = _TOP_MAP[key]
+        if key == "lm_head" and template not in tensors:
+            warnings.warn(
+                f"config has tie_word_embeddings=False but {template!r} is "
+                f"missing from the checkpoint at {path}; falling back to the "
+                "transposed embedding table (tied head). If the checkpoint "
+                "really has an untied head, check its tensor names.",
+                stacklevel=3,
+            )
+            emb_name, _ = _TOP_MAP["embed_tokens"]
+            # our lm_head is [H, V]; the embedding is stored [V, H]
+            cb = flat_cb(emb_name, True)
+        else:
+            cb = flat_cb(template, transpose)
+        params[key] = leaf_from_callback(
+            shapes[key].shape, shardings[key], cb
+        )
+
+    for key, sd in shapes["layers"].items():
+        template, transpose = _LAYER_MAP[key]
+        cb = expert_cb(template, transpose) if "{e}" in template \
+            else stacked_cb(template, transpose)
+        params["layers"][key] = leaf_from_callback(
+            sd.shape, shardings["layers"][key], cb
+        )
+
+    for h in handles:
+        close = getattr(h, "close", None)
+        if close:
+            close()
     return params
 
 
-def save_hf_params(path: str, params: Params, cfg) -> str:
-    """Write our param tree as a HF-layout safetensors checkpoint
-    (single ``model.safetensors``). Returns the file path."""
-    from safetensors.numpy import save_file
+def save_hf_params(
+    path: str,
+    params: Params,
+    cfg,
+    *,
+    dtype: str = "float32",
+    max_shard_bytes: int = 5 * 1024**3,
+) -> str:
+    """Write our param tree as a HF-layout safetensors checkpoint.
 
+    ``dtype``: 'float32' or 'bfloat16' (HF checkpoints ship bf16; torch
+    carries the bf16 dtype since numpy has none). When the total exceeds
+    ``max_shard_bytes`` the standard sharded layout is written —
+    model-0000x-of-0000N.safetensors + model.safetensors.index.json —
+    exactly what transformers/safe_open expect, one shard materialised at
+    a time. Returns the single file path, or the index path when sharded.
+    """
+
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"dtype must be float32|bfloat16, got {dtype!r}")
     os.makedirs(path, exist_ok=True)
-    is_moe = "expert_gate_proj" in params["layers"]
-    out: Dict[str, np.ndarray] = {}
+    esize = 2 if dtype == "bfloat16" else 4
 
-    def put(template: str, transpose: bool, value, **fmt):
-        v = np.asarray(jax.device_get(value), dtype=np.float32)
-        out[template.format(**fmt)] = v.T.copy() if transpose else v
+    # Pass 1 — names + sizes only, nothing materialised: (name, getter)
+    # in HF insertion order.
+    entries: list = []
 
-    put(*_TOP_MAP["embed_tokens"], params["embed_tokens"])
-    put(*_TOP_MAP["norm"], params["norm"])
+    def plan(template: str, transpose: bool, value, **fmt):
+        entries.append((template.format(**fmt), transpose, value))
+
+    plan(*_TOP_MAP["embed_tokens"], params["embed_tokens"])
+    plan(*_TOP_MAP["norm"], params["norm"])
     if "lm_head" in params:
-        put(*_TOP_MAP["lm_head"], params["lm_head"])
-
+        plan(*_TOP_MAP["lm_head"], params["lm_head"])
     for key, stacked in params["layers"].items():
         template, transpose = _LAYER_MAP[key]
         for i in range(stacked.shape[0]):
             if "{e}" in template:
                 for e in range(stacked.shape[1]):
-                    put(template, transpose, stacked[i, e], i=i, e=e)
+                    plan(template, transpose, stacked[i, e], i=i, e=e)
             else:
-                put(template, transpose, stacked[i], i=i)
+                plan(template, transpose, stacked[i], i=i)
 
-    f = os.path.join(path, "model.safetensors")
-    save_file(out, f)
-    return f
+    nbytes = {name: int(np.prod(v.shape)) * esize for name, _, v in entries}
+    total = sum(nbytes.values())
+
+    def materialise(name, transpose, value):
+        v = np.asarray(jax.device_get(value), dtype=np.float32)
+        # always copy: jax hands out read-only buffers writers can't wrap
+        v = (v.T if transpose else v).copy()
+        if dtype == "bfloat16":
+            # numpy has no bf16; torch (CPU) carries the dtype into the
+            # safetensors header. Imported only on this path so the fp32
+            # export keeps working without torch installed.
+            import torch
+
+            return torch.from_numpy(v).to(torch.bfloat16)
+        return v
+
+    def write(tensor_dict, fname):
+        if dtype == "bfloat16":
+            from safetensors.torch import save_file
+        else:
+            from safetensors.numpy import save_file
+        save_file(tensor_dict, os.path.join(path, fname))
+
+    if total <= max_shard_bytes:
+        write({n: materialise(n, t, v) for n, t, v in entries},
+              "model.safetensors")
+        return os.path.join(path, "model.safetensors")
+
+    # Greedy sharding in insertion order (transformers' shard recipe);
+    # pass 2 materialises ONE shard at a time, so peak host memory is one
+    # shard, not the model.
+    shards: list[list] = [[]]
+    size = 0
+    for entry in entries:
+        if shards[-1] and size + nbytes[entry[0]] > max_shard_bytes:
+            shards.append([])
+            size = 0
+        shards[-1].append(entry)
+        size += nbytes[entry[0]]
+    n = len(shards)
+    weight_map: Dict[str, str] = {}
+    for i, shard in enumerate(shards, start=1):
+        fname = f"model-{i:05d}-of-{n:05d}.safetensors"
+        write({nm: materialise(nm, t, v) for nm, t, v in shard}, fname)
+        weight_map.update({nm: fname for nm, _, _ in shard})
+    index = os.path.join(path, "model.safetensors.index.json")
+    with open(index, "w") as f:
+        json.dump(
+            {"metadata": {"total_size": total}, "weight_map": weight_map},
+            f, indent=0,
+        )
+    return index
 
 
 _HF_LAYER_RE = re.compile(r"model\.layers\.(\d+)\.")
