@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""minGPT char-LM training example (dense or MoE).
+
+Counterpart of reference examples/torch_examples/minigpt/{main,trainer}.py:
+a small GPT trained on character windows with AdamW + cosine schedule,
+periodic eval loss, and a sampled continuation at the end. DP comes from
+sharding the batch over all local devices inside one jitted step (the
+reference drives the same loop through torchrun DDP).
+
+BASELINE.json config 2 ("minGPT char-LM DP") is this program with the
+default --use_moe false; --use_moe true exercises the educational
+noisy-top-k MoE (reference examples moe.py).
+
+Usage:
+    python examples/mingpt/train_mingpt.py --steps 300
+    python examples/mingpt/train_mingpt.py --data_path shakespeare.txt \
+        --use_moe true --steps 2000
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def main(argv=None) -> float:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--data_path", default=None)
+    ap.add_argument("--block_size", type=int, default=128)
+    ap.add_argument("--n_layer", type=int, default=4)
+    ap.add_argument("--n_head", type=int, default=4)
+    ap.add_argument("--n_embd", type=int, default=128)
+    ap.add_argument("--use_moe", type=lambda s: s.lower() in ("1", "true"),
+                    default=False)
+    ap.add_argument("--num_experts", type=int, default=8)
+    ap.add_argument("--top_k", type=int, default=2)
+    ap.add_argument("--batch_size", type=int, default=64,
+                    help="global batch (split over dp)")
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--eval_interval", type=int, default=100)
+    ap.add_argument("--eval_batches", type=int, default=8)
+    ap.add_argument("--sample_tokens", type=int, default=64)
+    ap.add_argument("--data_parallel", type=int, default=0,
+                    help="dp degree; 0 = all local devices")
+    args = ap.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from scaletorch_tpu.models import gpt_moe
+    from examples.mingpt.char_dataset import load_dataset
+
+    ds, source = load_dataset(args.data_path, args.block_size)
+    print(f"corpus={source} chars={len(ds.text)} vocab={ds.vocab_size}")
+
+    cfg = gpt_moe.GPTMoEConfig(
+        block_size=args.block_size, vocab_size=ds.vocab_size,
+        n_layer=args.n_layer, n_head=args.n_head, n_embd=args.n_embd,
+        use_moe=args.use_moe, num_experts=args.num_experts,
+        top_k=args.top_k,
+    )
+    params = gpt_moe.init_params(jax.random.PRNGKey(0), cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    print(f"model: {n_params / 1e6:.2f}M params, moe={cfg.use_moe}")
+
+    dp = args.data_parallel or len(jax.local_devices())
+    mesh = Mesh(np.asarray(jax.devices()[:dp]), ("dp",))
+    batch_sharding = NamedSharding(mesh, P("dp"))
+
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, args.lr, args.warmup, max(args.steps, args.warmup + 1)
+    )
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(sched))
+    opt_state = tx.init(params)
+
+    def loss_fn(p, x, y, key):
+        logits, aux = gpt_moe.forward(
+            p, x, cfg, noise_key=key if cfg.use_moe else None,
+            return_aux=True,
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll = -jnp.take_along_axis(logp, y[..., None], axis=-1).mean()
+        return nll + aux, nll
+
+    @jax.jit
+    def train_step(p, opt_state, x, y, key):
+        (loss, nll), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            p, x, y, key
+        )
+        updates, opt_state = tx.update(grads, opt_state, p)
+        return optax.apply_updates(p, updates), opt_state, nll
+
+    @jax.jit
+    def eval_step(p, x, y):
+        _, nll = loss_fn(p, x, y, None)
+        return nll
+
+    rng = np.random.default_rng(0)
+    train_it = ds.batches("train", args.batch_size, rng)
+    test_it = ds.batches("test", args.batch_size, rng)
+    key = jax.random.PRNGKey(1)
+
+    def put(x):
+        return jax.device_put(x, batch_sharding)
+
+    t0, last_eval = time.time(), float("inf")
+    for step in range(1, args.steps + 1):
+        x, y = next(train_it)
+        key, sub = jax.random.split(key)
+        params, opt_state, nll = train_step(params, opt_state, put(x), put(y), sub)
+        if step % args.eval_interval == 0 or step == args.steps:
+            evals = [
+                float(eval_step(params, put(ex), put(ey)))
+                for ex, ey in (next(test_it) for _ in range(args.eval_batches))
+            ]
+            last_eval = sum(evals) / len(evals)
+            tok_s = step * args.batch_size * args.block_size / (time.time() - t0)
+            print(f"step {step}/{args.steps} train_nll {float(nll):.4f} "
+                  f"eval_nll {last_eval:.4f} tok/s {tok_s:,.0f} dp={dp}")
+
+    prompt = ds.encode(ds.text[:16])[None, :]
+    out = gpt_moe.generate(
+        params, jnp.asarray(prompt), cfg,
+        max_new_tokens=args.sample_tokens, temperature=0.8,
+        key=jax.random.PRNGKey(2),
+    )
+    print("sample:", repr(ds.decode(np.asarray(out)[0])))
+    return last_eval
+
+
+if __name__ == "__main__":
+    main()

@@ -204,6 +204,18 @@ class OptimizerArguments:
 class TrainingArguments:
     micro_batch_size: int = 1
     gradient_accumulation_steps: int = 1
+    eval_frequency: int = field(
+        default=0,
+        metadata={"help": "Run validation every N optimizer steps (0 = off)."},
+    )
+    eval_steps: int = field(
+        default=8, metadata={"help": "Validation batches per evaluation."}
+    )
+    eval_dataset_name: Optional[str] = field(
+        default=None,
+        metadata={"help": "Held-out dataset (json/jsonl/hub). Synthetic runs "
+                          "use a disjoint synthetic stream when unset."},
+    )
     global_batch_size: Optional[int] = field(
         default=None,
         metadata={"help": "If set, must equal dp * micro_batch_size * grad_accum."},

@@ -106,6 +106,174 @@ def batch_specs(with_cp: bool = True) -> Dict[str, P]:
     }
 
 
+def _build_losses(
+    mm: MeshManager,
+    model_forward: Callable,
+    model_cfg,
+    *,
+    attention_backend: str,
+    gradient_checkpointing: bool,
+    remat_policy: str,
+    sequence_parallel: bool,
+    head_weight_fn: Callable,
+    custom_param_specs: bool,
+    model_kwargs: Optional[Dict[str, Any]],
+    model_family: str,
+    pp_schedule: str,
+) -> Tuple[Callable, Optional[Callable], bool]:
+    """(loss_fn, pipe_loss, pipe_has_aux) — the per-microbatch loss for the
+    non-PP path and, when mm.pp > 1, the pipeline loss. Shared by the
+    train step and the eval step so both compute the identical objective."""
+
+    def loss_fn(p, mb):
+        out = model_forward(
+            p,
+            mb["input_ids"],
+            model_cfg,
+            positions=mb["position_ids"],
+            attention_backend=attention_backend,
+            gradient_checkpointing=gradient_checkpointing,
+            remat_policy=remat_policy,
+            tp_axis="tp",
+            sequence_parallel=sequence_parallel,
+            return_hidden=True,
+            **(model_kwargs or {}),
+        )
+        # MoE forwards return (hidden, scaled_aux_loss[, stats]) — add the
+        # aux to the CE (reference train_step adds model.get_aux_loss());
+        # stats (expert load / drop rates) ride along as has_aux extras so
+        # the operator sees routing health per step (VERDICT r1 weak #5).
+        if isinstance(out, tuple):
+            hidden, aux = out[0], out[1]
+            extras = out[2] if len(out) == 3 else {}
+        else:
+            hidden, aux, extras = out, 0.0, {}
+        # Head + CE fused over sequence chunks: full [B, S, V] logits never
+        # materialise (vocab-parallel over tp AND chunk-rematerialised).
+        head = head_weight_fn(p, model_cfg, "tp")
+        ce = fused_vocab_parallel_cross_entropy(
+            hidden, head, mb["target_ids"], axis="tp"
+        )
+        return ce + aux, extras
+
+    if mm.pp == 1:
+        return loss_fn, None, False
+
+    if pp_schedule not in ("afab", "1f1b"):
+        raise ValueError(f"pp_schedule must be 'afab' or '1f1b', got {pp_schedule}")
+    if model_family == "qwen3_moe":
+        # PP x EP: each stage's MoE layers run the ep all-to-all inside
+        # stage compute; live-tick aux losses ride the pipeline carry
+        # (pipeline_parallel.make_moe_pipeline_loss).
+        from scaletorch_tpu.parallel.pipeline_parallel import (
+            make_moe_pipeline_loss,
+        )
+
+        pipe_loss = make_moe_pipeline_loss(
+            mm, model_cfg,
+            attention_backend=attention_backend,
+            gradient_checkpointing=gradient_checkpointing,
+            remat_policy=remat_policy,
+            sequence_parallel=sequence_parallel,
+            head_weight_fn=head_weight_fn,
+        )
+        return loss_fn, pipe_loss, True
+    if custom_param_specs:
+        # The PP path composes the built-in pipeline pieces (embed /
+        # decoder_stack / final_hidden) over the pp-sharded stacked
+        # layer axis; a custom params tree would be silently trained
+        # against the wrong computation.
+        raise NotImplementedError(
+            "pp > 1 supports the built-in Llama/Qwen3/Qwen3-MoE "
+            "families only (custom param_specs/model_forward not yet "
+            "wired into the pipeline schedule)"
+        )
+    from scaletorch_tpu.parallel.pipeline_parallel import (
+        make_llama_pipeline_loss,
+    )
+
+    pipe_loss = make_llama_pipeline_loss(
+        mm, model_cfg,
+        attention_backend=attention_backend,
+        gradient_checkpointing=gradient_checkpointing,
+        remat_policy=remat_policy,
+        sequence_parallel=sequence_parallel,
+        head_weight_fn=head_weight_fn,
+    )
+    return loss_fn, pipe_loss, False
+
+
+def make_spmd_eval_step(
+    mm: MeshManager,
+    model_forward: Callable,
+    model_cfg,
+    *,
+    attention_backend: str = "sdpa",
+    sequence_parallel: bool = False,
+    head_weight_fn: Optional[Callable] = None,
+    param_specs: Any = None,
+    model_kwargs: Optional[Dict[str, Any]] = None,
+    model_family: str = "llama",
+) -> Tuple[Callable, Any]:
+    """Jitted validation step ``(params, batch) -> loss`` over the same 5D
+    mesh and loss form as the train step, minus backward/update — the
+    Trainer's validation loop (role of reference make_eval_step +
+    trainer eval leg). Returns (eval_fn, param_specs)."""
+    use_pp = mm.pp > 1
+    p_specs = (
+        param_specs
+        if param_specs is not None
+        else llama_param_specs(
+            model_cfg, tp_axis="tp", pp_axis="pp" if use_pp else None
+        )
+    )
+    if head_weight_fn is None:
+        from scaletorch_tpu.models.llama import lm_head_weight as head_weight_fn
+
+    loss_fn, pipe_loss, pipe_has_aux = _build_losses(
+        mm, model_forward, model_cfg,
+        attention_backend=attention_backend,
+        gradient_checkpointing=False,  # no backward: nothing to remat
+        remat_policy="nothing_saveable",
+        sequence_parallel=sequence_parallel,
+        head_weight_fn=head_weight_fn,
+        custom_param_specs=param_specs is not None,
+        model_kwargs=model_kwargs,
+        model_family=model_family,
+        pp_schedule="afab",
+    )
+    all_axes = DATA_AXES + ("ep",) + (("tp", "pp") if use_pp else ("tp",))
+
+    def eval_step(p, batch):
+        from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+
+        p_v = jax.tree.map(lambda x: pvary_missing(x, all_axes), p)
+        if use_pp:
+            out = pipe_loss(p_v, batch)
+            loss = out[0] if pipe_has_aux else out
+            loss = pvary_missing(loss, all_axes)
+        else:
+            accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
+
+            def micro(acc, mb):
+                loss, _ = loss_fn(p_v, mb)
+                return acc + pvary_missing(loss, all_axes), None
+
+            loss_sum, _ = jax.lax.scan(
+                micro, jax.lax.pvary(jnp.float32(0.0), all_axes), batch
+            )
+            loss = loss_sum / accum
+        return jax.lax.pmean(loss, all_axes)
+
+    sharded = jax.shard_map(
+        eval_step,
+        mesh=mm.mesh,
+        in_specs=(p_specs, batch_specs()),
+        out_specs=P(),
+    )
+    return jax.jit(sharded), p_specs
+
+
 def make_spmd_train_step(
     mm: MeshManager,
     model_forward: Callable,
@@ -160,86 +328,23 @@ def make_spmd_train_step(
     if head_weight_fn is None:
         from scaletorch_tpu.models.llama import lm_head_weight as head_weight_fn
 
-    def loss_fn(p, mb):
-        out = model_forward(
-            p,
-            mb["input_ids"],
-            model_cfg,
-            positions=mb["position_ids"],
-            attention_backend=attention_backend,
-            gradient_checkpointing=gradient_checkpointing,
-            remat_policy=remat_policy,
-            tp_axis="tp",
-            sequence_parallel=sequence_parallel,
-            return_hidden=True,
-            **(model_kwargs or {}),
-        )
-        # MoE forwards return (hidden, scaled_aux_loss[, stats]) — add the
-        # aux to the CE (reference train_step adds model.get_aux_loss());
-        # stats (expert load / drop rates) ride along as has_aux extras so
-        # the operator sees routing health per step (VERDICT r1 weak #5).
-        if isinstance(out, tuple):
-            hidden, aux = out[0], out[1]
-            extras = out[2] if len(out) == 3 else {}
-        else:
-            hidden, aux, extras = out, 0.0, {}
-        # Head + CE fused over sequence chunks: full [B, S, V] logits never
-        # materialise (vocab-parallel over tp AND chunk-rematerialised).
-        head = head_weight_fn(p, model_cfg, "tp")
-        ce = fused_vocab_parallel_cross_entropy(
-            hidden, head, mb["target_ids"], axis="tp"
-        )
-        return ce + aux, extras
+    loss_fn, pipe_loss, pipe_has_aux = _build_losses(
+        mm, model_forward, model_cfg,
+        attention_backend=attention_backend,
+        gradient_checkpointing=gradient_checkpointing,
+        remat_policy=remat_policy,
+        sequence_parallel=sequence_parallel,
+        head_weight_fn=head_weight_fn,
+        custom_param_specs=param_specs is not None,
+        model_kwargs=model_kwargs,
+        model_family=model_family,
+        pp_schedule=pp_schedule,
+    )
 
     # 'ep' is always a data axis for the batch (batch_specs shards rows
     # over ("dp","ep")), so it is always in the pvary set — even at ep=1
     # the vma bookkeeping must line up.
     all_axes = DATA_AXES + ("ep",) + (("tp", "pp") if use_pp else ("tp",))
-
-    pipe_has_aux = False
-    if use_pp:
-        if pp_schedule not in ("afab", "1f1b"):
-            raise ValueError(f"pp_schedule must be 'afab' or '1f1b', got {pp_schedule}")
-        if model_family == "qwen3_moe":
-            # PP x EP: each stage's MoE layers run the ep all-to-all inside
-            # stage compute; live-tick aux losses ride the pipeline carry
-            # (pipeline_parallel.make_moe_pipeline_loss).
-            from scaletorch_tpu.parallel.pipeline_parallel import (
-                make_moe_pipeline_loss,
-            )
-
-            pipe_loss = make_moe_pipeline_loss(
-                mm, model_cfg,
-                attention_backend=attention_backend,
-                gradient_checkpointing=gradient_checkpointing,
-                remat_policy=remat_policy,
-                sequence_parallel=sequence_parallel,
-                head_weight_fn=head_weight_fn,
-            )
-            pipe_has_aux = True
-        elif param_specs is not None:
-            # The PP path composes the built-in pipeline pieces (embed /
-            # decoder_stack / final_hidden) over the pp-sharded stacked
-            # layer axis; a custom params tree would be silently trained
-            # against the wrong computation.
-            raise NotImplementedError(
-                "pp > 1 supports the built-in Llama/Qwen3/Qwen3-MoE "
-                "families only (custom param_specs/model_forward not yet "
-                "wired into the pipeline schedule)"
-            )
-        else:
-            from scaletorch_tpu.parallel.pipeline_parallel import (
-                make_llama_pipeline_loss,
-            )
-
-            pipe_loss = make_llama_pipeline_loss(
-                mm, model_cfg,
-                attention_backend=attention_backend,
-                gradient_checkpointing=gradient_checkpointing,
-                remat_policy=remat_policy,
-                sequence_parallel=sequence_parallel,
-                head_weight_fn=head_weight_fn,
-            )
 
     def step(p, opt_state, batch):
         accum = jax.tree_util.tree_leaves(batch)[0].shape[0]

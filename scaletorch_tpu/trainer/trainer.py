@@ -83,6 +83,15 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
         return qwen3.Qwen3Config(qk_norm=True, **common)
     if cfg.model_type == "llama":
         return llama.LlamaConfig(**common)
+    if cfg.model_type in ("lenet", "gpt_moe", "mingpt"):
+        # These are the examples-tier models (reference
+        # examples/torch_examples/{mnist,minigpt}) — they have their own
+        # training mains rather than the LLM Trainer's seq/CE pipeline.
+        raise ValueError(
+            f"model_type {cfg.model_type!r} trains via its example: "
+            "examples/mnist/train_mnist.py (lenet) or "
+            "examples/mingpt/train_mingpt.py (gpt_moe/mingpt)"
+        )
     raise ValueError(f"unknown model_type {cfg.model_type!r}")
 
 
@@ -295,6 +304,22 @@ class Trainer:
         self.global_step = 0
         self.tokens_seen = 0
         self._ckpt_mgr = None
+        self._eval_fn = None
+        self._eval_loader = None
+        self._eval_batches = None
+        if cfg.eval_frequency:
+            from scaletorch_tpu.parallel.spmd import make_spmd_eval_step
+
+            self._eval_fn, _ = make_spmd_eval_step(
+                self.mm, fwd_fn, self.model_cfg,
+                attention_backend=self.attention_backend,
+                sequence_parallel=cfg.sequence_parallel,
+                head_weight_fn=head_weight_fn,
+                param_specs=param_specs,
+                model_kwargs=model_kwargs,
+                model_family="qwen3_moe" if is_moe else "llama",
+            )
+            self._eval_loader = self._build_eval_loader()
 
         self._wandb = None
         if cfg.wandb_project and jax.process_index() == 0:
@@ -322,6 +347,45 @@ class Trainer:
                 async_save=self.cfg.async_checkpointing,
             )
         return self._ckpt_mgr
+
+    def _build_eval_loader(self):
+        """Validation stream: eval_dataset_name when given; a disjoint-seed
+        synthetic stream for synthetic runs; else None (eval skipped, with
+        a warning — the concat-chunk train pipeline has no held-out split).
+        Both paths reuse build_dataloader so eval batches always match the
+        train batch contract."""
+        import dataclasses as _dc
+
+        cfg = self.cfg
+        if cfg.eval_dataset_name:
+            eval_cfg = _dc.replace(
+                cfg, dataset_name=cfg.eval_dataset_name, synthetic_data=False
+            )
+            return build_dataloader(eval_cfg, self.model_cfg)
+        if cfg.synthetic_data or not cfg.dataset_name:
+            # disjoint seed from the train stream
+            eval_cfg = _dc.replace(cfg, seed=cfg.seed + 104729)
+            return build_dataloader(eval_cfg, self.model_cfg)
+        self.logger.warning(
+            "eval_frequency set but no eval_dataset_name; validation skipped"
+        )
+        return None
+
+    def evaluate(self, num_batches: Optional[int] = None) -> Optional[float]:
+        """Mean validation loss over a FIXED set of ``num_batches``
+        (cfg.eval_steps) batches — cached on first call so successive
+        validations score the same data and val_loss deltas measure
+        learning, not sampling noise."""
+        if self._eval_fn is None or self._eval_loader is None:
+            return None
+        num_batches = num_batches or self.cfg.eval_steps
+        if self._eval_batches is None or len(self._eval_batches) < num_batches:
+            it = iter(self._eval_loader)
+            self._eval_batches = [next(it) for _ in range(num_batches)]
+        total = 0.0
+        for batch in self._eval_batches[:num_batches]:
+            total += float(self._eval_fn(self.params, self._device_batch(batch)))
+        return total / max(num_batches, 1)
 
     def _device_batch(self, batch: Dict[str, np.ndarray]) -> Dict[str, jax.Array]:
         # put_global: device_put single-process; per-process addressable
@@ -354,6 +418,16 @@ class Trainer:
                 extras={k: v for k, v in m.items()
                         if k not in ("loss", "grad_norm")},
             )
+            if (
+                self.cfg.eval_frequency
+                and self.global_step % self.cfg.eval_frequency == 0
+            ):
+                val = self.evaluate()
+                if val is not None:
+                    self.logger.info(
+                        f"step {self.global_step:>6} | val_loss {val:.4f}"
+                    )
+                    last = {**last, "val_loss": val}
             if last and self._wandb is not None:
                 self._wandb.log(last, step=self.global_step)
             if (

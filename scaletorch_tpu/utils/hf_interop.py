@@ -375,12 +375,14 @@ def save_hf_params(
     os.makedirs(path, exist_ok=True)
     esize = 2 if dtype == "bfloat16" else 4
 
-    # Pass 1 — names + sizes only, nothing materialised: (name, getter)
-    # in HF insertion order.
+    # Pass 1 — names + sizes only, nothing materialised: entries hold
+    # (name, transpose, leaf_ref, index_into_leaf) in HF insertion order
+    # (indexing deferred to materialise so no per-tensor slices are
+    # dispatched or kept alive up front).
     entries: list = []
 
-    def plan(template: str, transpose: bool, value, **fmt):
-        entries.append((template.format(**fmt), transpose, value))
+    def plan(template: str, transpose: bool, leaf, idx=(), **fmt):
+        entries.append((template.format(**fmt), transpose, leaf, idx))
 
     plan(*_TOP_MAP["embed_tokens"], params["embed_tokens"])
     plan(*_TOP_MAP["norm"], params["norm"])
@@ -391,14 +393,18 @@ def save_hf_params(
         for i in range(stacked.shape[0]):
             if "{e}" in template:
                 for e in range(stacked.shape[1]):
-                    plan(template, transpose, stacked[i, e], i=i, e=e)
+                    plan(template, transpose, stacked, (i, e), i=i, e=e)
             else:
-                plan(template, transpose, stacked[i], i=i)
+                plan(template, transpose, stacked, (i,), i=i)
 
-    nbytes = {name: int(np.prod(v.shape)) * esize for name, _, v in entries}
+    nbytes = {
+        name: int(np.prod(leaf.shape[len(idx):])) * esize
+        for name, _, leaf, idx in entries
+    }
     total = sum(nbytes.values())
 
-    def materialise(name, transpose, value):
+    def materialise(name, transpose, leaf, idx):
+        value = leaf[idx] if idx else leaf
         v = np.asarray(jax.device_get(value), dtype=np.float32)
         # always copy: jax hands out read-only buffers writers can't wrap
         v = (v.T if transpose else v).copy()
@@ -419,7 +425,7 @@ def save_hf_params(
         save_file(tensor_dict, os.path.join(path, fname))
 
     if total <= max_shard_bytes:
-        write({n: materialise(n, t, v) for n, t, v in entries},
+        write({n: materialise(n, t, lf, ix) for n, t, lf, ix in entries},
               "model.safetensors")
         return os.path.join(path, "model.safetensors")
 
@@ -438,8 +444,8 @@ def save_hf_params(
     weight_map: Dict[str, str] = {}
     for i, shard in enumerate(shards, start=1):
         fname = f"model-{i:05d}-of-{n:05d}.safetensors"
-        write({nm: materialise(nm, t, v) for nm, t, v in shard}, fname)
-        weight_map.update({nm: fname for nm, _, _ in shard})
+        write({nm: materialise(nm, t, lf, ix) for nm, t, lf, ix in shard}, fname)
+        weight_map.update({nm: fname for nm, _, _, _ in shard})
     index = os.path.join(path, "model.safetensors.index.json")
     with open(index, "w") as f:
         json.dump(

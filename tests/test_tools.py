@@ -1,0 +1,38 @@
+"""Tools tier: verify_weights self-test + profile breakdown math.
+
+(The bench tools are thin CLIs over scaletorch_tpu.benchmark, covered by
+tests/test_benchmark.py; pp_schedule_compare's prediction model is
+asserted against its own measured output in its docstring run.)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_verify_weights_synthetic_self_test(capsys):
+    from tools.verify_weights import synthetic_self_test
+
+    assert synthetic_self_test()
+    out = capsys.readouterr().out
+    assert "forward: PASS" in out
+    assert "backward: PASS" in out
+    assert "RESULT: OK" in out
+
+
+def test_profile_flops_breakdown_matches_mfu_formula():
+    from scaletorch_tpu.models.presets import preset
+    from tools.profile_mfu import flops_breakdown
+
+    p = preset("qwen3-0.6b")
+    seq = 8192
+    br = flops_breakdown(p, seq)
+    assert br["forward"] == br["linear"] + br["attention"] + br["embed_head"]
+    # attention term matches the shared MFU formula's 12*L*heads*hd*seq
+    # (utils/misc.get_mfu): 3x the forward 4*L*heads*hd*seq
+    assert 3 * br["attention"] == 12 * p["num_hidden_layers"] * \
+        p["num_attention_heads"] * p["head_dim"] * seq

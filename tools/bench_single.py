@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Benchmark one configuration and print a JSON result.
+
+Counterpart of reference tools/bench_single.py (one model/shape timed
+with warmup + steady window). Thin CLI over scaletorch_tpu.benchmark.
+
+Usage:
+    python tools/bench_single.py --model qwen3-0.6b --seq 8192 --gc
+    python tools/bench_single.py --model qwen3-30b-a3b --seq 4096 \
+        --tp 4 --ep 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="qwen3-0.6b",
+                    help="preset name (scaletorch_tpu/models/presets.py)")
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--bs", type=int, default=1)
+    ap.add_argument("--ga", type=int, default=1)
+    ap.add_argument("--gc", action="store_true")
+    ap.add_argument("--sp", action="store_true")
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--pp", type=int, default=1)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--cp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--pp_engine", default="afab")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--remat_policy", default="nothing_saveable")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=3)
+    args = ap.parse_args()
+
+    from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
+
+    cfg = make_bench_args(
+        args.model, seq=args.seq, micro_bs=args.bs, grad_accum=args.ga,
+        gc=args.gc, sp=args.sp, tp=args.tp, pp=args.pp, dp=args.dp,
+        cp=args.cp, ep=args.ep, pp_engine=args.pp_engine, dtype=args.dtype,
+        remat_policy=args.remat_policy,
+    )
+    r = benchmark_config(cfg, warmup=args.warmup, steps=args.steps)
+    r["config"] = {
+        "model": args.model, "seq": args.seq, "bs": args.bs, "ga": args.ga,
+        "gc": args.gc, "sp": args.sp, "tp": args.tp, "pp": args.pp,
+        "dp": args.dp, "cp": args.cp, "ep": args.ep, "dtype": args.dtype,
+    }
+    print(json.dumps(r))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Search training knobs for the best MFU on the current chip.
+
+Counterpart of reference tools/optimize_mfu.py (tries gc/compile/batch
+variants and reports the winner). The TPU knobs that matter here:
+remat policy (what GC saves), gradient checkpointing on/off, and
+micro-batch size. Each variant runs in-process with warmup; OOM variants
+are recorded and skipped.
+
+Usage:
+    python tools/optimize_mfu.py --model qwen3-0.6b --seq 8192
+    python tools/optimize_mfu.py --policies nothing_saveable dots_saveable
+"""
+
+from __future__ import annotations
+
+import argparse
+import gc as _gc
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_OOM = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="qwen3-0.6b")
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--policies", nargs="*", default=[
+        "nothing_saveable", "dots_saveable", "save_attn",
+    ])
+    ap.add_argument("--batch_sizes", nargs="*", type=int, default=[1, 2])
+    ap.add_argument("--try_no_gc", action="store_true",
+                    help="also try gradient_checkpointing off")
+    ap.add_argument("--out", default=None, help="write results JSON here")
+    args = ap.parse_args()
+
+    from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
+
+    variants = []
+    if args.try_no_gc:
+        for bs in args.batch_sizes:
+            variants.append((f"no-gc_bs{bs}", dict(gc=False, micro_bs=bs)))
+    for policy in args.policies:
+        for bs in args.batch_sizes:
+            variants.append((
+                f"gc-{policy}_bs{bs}",
+                dict(gc=True, remat_policy=policy, micro_bs=bs),
+            ))
+
+    results = []
+    for label, shape in variants:
+        cfg = make_bench_args(args.model, seq=args.seq, **shape)
+        try:
+            r = benchmark_config(cfg, warmup=args.warmup, steps=args.steps)
+            results.append({"label": label, **r})
+            print(f"{label:<28} MFU {r['mfu']:6.2f}%  "
+                  f"tok/s {r['tokens_per_second']:>10,.0f}", flush=True)
+        except Exception as e:  # noqa: BLE001 — record and continue
+            status = "OOM" if any(m in repr(e) for m in _OOM) else "FAILED"
+            results.append({"label": label, "error": status})
+            print(f"{label:<28} {status}", flush=True)
+            _gc.collect()
+
+    ok = [r for r in results if "mfu" in r]
+    if ok:
+        best = max(ok, key=lambda r: r["mfu"])
+        print(f"\nbest: {best['label']} at {best['mfu']}% MFU "
+              f"({best['tokens_per_second']:,.0f} tok/s)")
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+        print(f"results written to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
