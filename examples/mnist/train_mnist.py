@@ -66,15 +66,17 @@ def _find(data_dir: str, stem: str):
 def load_mnist(data_dir):
     """(train_x, train_y, test_x, test_y) as float32 [N,28,28,1] in [0,1]."""
     if data_dir:
-        imgs = _find(data_dir, "train-images-idx3-ubyte")
-        if imgs:
-            tx = load_idx_images(imgs)
-            ty = load_idx_labels(_find(data_dir, "train-labels-idx1-ubyte"))
-            ex = load_idx_images(_find(data_dir, "t10k-images-idx3-ubyte"))
-            ey = load_idx_labels(_find(data_dir, "t10k-labels-idx1-ubyte"))
+        stems = ("train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+                 "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte")
+        paths = [_find(data_dir, s) for s in stems]
+        if all(paths):
+            tx, ex = load_idx_images(paths[0]), load_idx_images(paths[2])
+            ty, ey = load_idx_labels(paths[1]), load_idx_labels(paths[3])
             norm = lambda a: (a.astype(np.float32) / 255.0)[..., None]  # noqa: E731
             return norm(tx), ty.astype(np.int32), norm(ex), ey.astype(np.int32)
-        print(f"no MNIST idx files under {data_dir}; using synthetic digits")
+        missing = [s for s, p in zip(stems, paths) if p is None]
+        print(f"missing MNIST idx files under {data_dir} "
+              f"({', '.join(missing)}); using synthetic digits")
     return synthetic_digits(12000) + synthetic_digits(2000, seed=1)
 
 

@@ -55,3 +55,7 @@ register_env("DTYPE", "bfloat16", str)              # compute dtype
 register_env("SCALETORCH_TPU_DEVICE_FLOPS", "", str)  # peak-FLOPS override
 register_env("SCALETORCH_TPU_MATMUL_PRECISION", "", str)
 register_env("SCALETORCH_TPU_DISABLE_PALLAS", "0", _as_bool)  # force XLA fallbacks
+# Force the Pallas kernels on when local-device sniffing can't see the TPU:
+# AOT compile-only sessions (tools/aot_memory.py) have no local devices at
+# all, and remote-execution PJRT plugins may report a tunnel platform name.
+register_env("SCALETORCH_TPU_FORCE_PALLAS", "0", _as_bool)

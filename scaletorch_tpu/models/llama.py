@@ -38,6 +38,7 @@ from scaletorch_tpu.models.layers import (
     get_cos_sin,
     rms_norm,
     sdpa_attention,
+    swiglu,
 )
 from scaletorch_tpu.models.registry import (
     get_attention_backend,
@@ -279,9 +280,9 @@ def _decoder_layer(
     # ---- SwiGLU MLP (reference llama.py:207-249) ----------------------------
     h = rms_norm(x, pv(layer["post_attention_layernorm"]), cfg.rms_norm_eps)
     h = enter_full_seq(h)
-    gate = jax.nn.silu(col(h, layer["gate_proj"]))
+    gate = col(h, layer["gate_proj"])
     up = col(h, layer["up_proj"])
-    x = x + row(gate * up, layer["down_proj"])
+    x = x + row(swiglu(gate, up), layer["down_proj"])
     return x
 
 
@@ -357,7 +358,11 @@ def resolve_remat_policy(name: str):
         "nothing_saveable": cp.nothing_saveable,
         "dots_saveable": cp.dots_saveable,
         "dots_with_no_batch_dims_saveable": cp.dots_with_no_batch_dims_saveable,
-        "save_attn": cp.save_only_these_names("attn_out"),
+        # Keeps the flash kernel's (out, lse) residuals (named in
+        # ops/pallas/flash.py _flash_fwd) plus the layer-level attn output,
+        # so backward under GC skips the flash-forward recompute and runs
+        # the dq/dkv kernels directly off the saved statistics.
+        "save_attn": cp.save_only_these_names("attn_out", "attn_lse"),
     }
     if name not in policies:
         raise ValueError(

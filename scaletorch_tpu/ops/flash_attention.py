@@ -26,7 +26,18 @@ from scaletorch_tpu.models.registry import register_attention_backend
 def _pallas_available() -> bool:
     if get_env("SCALETORCH_TPU_DISABLE_PALLAS"):
         return False
-    return jax.local_devices()[0].platform == "tpu"
+    if get_env("SCALETORCH_TPU_FORCE_PALLAS"):
+        return True
+    try:
+        d = jax.local_devices()[0]
+    except Exception:  # AOT compile-only session: no local devices
+        return False
+    # Remote-execution PJRT plugins (device tunnels) expose TPU chips under
+    # their own platform name — ``platform == "tpu"`` alone would silently
+    # drop to the score-materialising SDPA fallback on REAL TPU hardware
+    # (34.6 GB of [L,B,H,S,S] scores at 0.6B/seq2048/bs2 per
+    # tools/aot_memory.py). Sniff the device kind too.
+    return d.platform == "tpu" or d.device_kind.startswith("TPU")
 
 
 def flash_attention(

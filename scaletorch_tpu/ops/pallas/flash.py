@@ -331,7 +331,15 @@ def _flash(q, k, v, causal, scale, bq, bkv, interpret):
 
 def _flash_fwd(q, k, v, causal, scale, bq, bkv, interpret):
     out, lse = _flash_forward(q, k, v, causal, scale, bq, bkv, interpret)
-    return out, (q, k, v, out, lse)
+    # Under jax.checkpoint the 'save_attn' policy keeps these two named
+    # residuals, so the backward kernels run off the SAVED (out, lse)
+    # instead of recomputing the whole flash forward inside the layer
+    # remat (models/llama.py resolve_remat_policy).
+    from jax.ad_checkpoint import checkpoint_name
+
+    out_r = checkpoint_name(out, "attn_out")
+    lse_r = checkpoint_name(lse, "attn_lse")
+    return out, (q, k, v, out_r, lse_r)
 
 
 def _flash_bwd(causal, scale, bq, bkv, interpret, res, g):

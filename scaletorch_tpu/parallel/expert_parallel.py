@@ -260,9 +260,11 @@ def moe_mlp(
         up_w = pvary_missing(up_w, tp_axis)
         down_w = pvary_missing(down_w, tp_axis)
         x_grouped = pvary_missing(x_grouped, tp_axis)
-    g = jax.nn.silu(jnp.einsum("eth,ehi->eti", x_grouped, gate_w))
+    from scaletorch_tpu.models.layers import swiglu
+
+    g = jnp.einsum("eth,ehi->eti", x_grouped, gate_w)
     u = jnp.einsum("eth,ehi->eti", x_grouped, up_w)
-    out = jnp.einsum("eti,eih->eth", g * u, down_w)
+    out = jnp.einsum("eti,eih->eth", swiglu(g, u), down_w)
     if tp_axis is not None and reduce == "sum":
         out = jax.lax.psum(out, tp_axis)
     return out

@@ -452,6 +452,21 @@ def make_spmd_train_step(
             grads = jax.tree.map(lambda g: g / nchunks, grads)
             loss = loss_sum / nchunks
             extras = {k: v / nchunks for k, v in extras_sum.items()}
+        elif accum == 1:
+            # No accumulation: differentiate the single microbatch directly.
+            # The scan below would carry an fp32 zeros tree (a full extra
+            # gradient copy — 2.4 GB at 0.6B) through a one-trip loop;
+            # accum is static under jit, so this branch is free.
+            mb = jax.tree.map(lambda x: jnp.squeeze(x, 0), batch)
+            (loss, extras), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                p_v, mb
+            )
+            # Match the scan path's fp32-gradient contract (cotangents are
+            # already fp32 for fp32 master params; this guards bf16-param
+            # trees so the reduce/clip/update below never run in bf16).
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            loss = pvary_missing(loss, all_axes)
+            extras = {k: pvary_missing(v, all_axes) for k, v in extras.items()}
         else:
 
             def micro_step(carry, mb):

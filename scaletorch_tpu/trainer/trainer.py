@@ -379,9 +379,19 @@ class Trainer:
         if self._eval_fn is None or self._eval_loader is None:
             return None
         num_batches = num_batches or self.cfg.eval_steps
-        if self._eval_batches is None or len(self._eval_batches) < num_batches:
+        if self._eval_batches is None:
+            self._eval_batches = []
+        if len(self._eval_batches) < num_batches:
+            # EXTEND the cached set rather than rebuilding: loaders share a
+            # mutable stream position (iter() continues, it does not
+            # restart), so a rebuild would re-draw the already-cached
+            # prefix from an advanced stream and break the fixed-eval-set
+            # contract for earlier val_loss readings. Extending keeps the
+            # prefix bit-identical and pins the new draws alongside it.
             it = iter(self._eval_loader)
-            self._eval_batches = [next(it) for _ in range(num_batches)]
+            self._eval_batches.extend(
+                next(it) for _ in range(num_batches - len(self._eval_batches))
+            )
         total = 0.0
         for batch in self._eval_batches[:num_batches]:
             total += float(self._eval_fn(self.params, self._device_batch(batch)))

@@ -85,7 +85,11 @@ def device_memory_stats(device: Optional[jax.Device] = None) -> dict[str, float]
 
 
 def is_tpu() -> bool:
-    return jax.local_devices()[0].platform == "tpu"
+    """True when the default device is a TPU chip — including chips served
+    by remote-execution PJRT plugins whose platform name is the tunnel's,
+    not "tpu" (their device_kind still reports the chip, e.g. "TPU v5 lite")."""
+    d = jax.local_devices()[0]
+    return d.platform == "tpu" or d.device_kind.startswith("TPU")
 
 
 def bf16_supported() -> bool:

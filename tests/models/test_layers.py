@@ -29,6 +29,51 @@ class TestRmsNorm:
         out = rms_norm(x, jnp.ones(8))
         assert out.dtype == jnp.bfloat16
 
+    def test_memory_lean_vjp_matches_autodiff(self):
+        """The custom VJP (saves original-dtype x/w, recomputes fp32
+        internals) must agree with plain autodiff of the same math."""
+
+        def ref(x, w, eps=1e-6):
+            x32 = x.astype(jnp.float32)
+            v = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+            return (x32 * jax.lax.rsqrt(v + eps) * w).astype(x.dtype)
+
+        # Layer-norm shape ([B,S,H] vs [H]) and per-head qk-norm shape
+        # ([B,S,Hq,Dh] vs [Dh]) exercise both dw broadcast-reduction paths.
+        for shape, wshape in (((2, 5, 8), (8,)), ((2, 5, 4, 8), (8,))):
+            x = jax.random.normal(jax.random.PRNGKey(0), shape)
+            w = jax.random.normal(jax.random.PRNGKey(1), wshape) + 1.0
+            loss = lambda f: lambda a, b: jnp.sum(jnp.sin(f(a, b)))  # noqa: E731
+            gx, gw = jax.grad(loss(rms_norm), argnums=(0, 1))(x, w)
+            rx, rw = jax.grad(loss(ref), argnums=(0, 1))(x, w)
+            np.testing.assert_allclose(gx, rx, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(gw, rw, rtol=1e-5, atol=1e-6)
+
+
+class TestSwiglu:
+    def test_forward_and_vjp_match_autodiff(self):
+        from scaletorch_tpu.models.layers import swiglu
+
+        g = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 32))
+        u = jax.random.normal(jax.random.PRNGKey(3), (2, 16, 32))
+        np.testing.assert_allclose(
+            swiglu(g, u), jax.nn.silu(g) * u, rtol=1e-6)
+        s1 = jax.grad(lambda a, b: jnp.sum(swiglu(a, b) ** 2), argnums=(0, 1))(g, u)
+        s2 = jax.grad(
+            lambda a, b: jnp.sum((jax.nn.silu(a) * b) ** 2), argnums=(0, 1))(g, u)
+        for got, want in zip(s1, s2):
+            np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_bf16_dtype_preserved(self):
+        from scaletorch_tpu.models.layers import swiglu
+
+        g = jax.random.normal(jax.random.PRNGKey(4), (4, 8), jnp.bfloat16)
+        u = jax.random.normal(jax.random.PRNGKey(5), (4, 8), jnp.bfloat16)
+        out, vjp = jax.vjp(swiglu, g, u)
+        assert out.dtype == jnp.bfloat16
+        dg, du = vjp(jnp.ones_like(out))
+        assert dg.dtype == jnp.bfloat16 and du.dtype == jnp.bfloat16
+
 
 class TestRope:
     def test_rotation_preserves_norm(self):

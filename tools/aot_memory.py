@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""AOT HBM analyzer: compile a train-step for a target TPU gen with NO
+device attached and report the compiler's exact memory accounting.
+
+TPU-native counterpart of the reference's trial-and-error OOM probing
+(scripts/benchmark_comprehensive.py catches torch.cuda OOM at runtime;
+tools/optimize_mfu.py re-runs variants until one fits): XLA knows the
+peak HBM of a compiled program before it ever touches a chip, so memory
+feasibility is a compile-time query. Uses the local ``libtpu`` AOT
+plugin via ``jax.experimental.topologies`` — works on a CPU-only box.
+
+Usage:
+    python tools/aot_memory.py --model qwen3-0.6b --seq 2048 --bs 2
+    python tools/aot_memory.py --model qwen3-0.6b --seq 8192 --gc \\
+        --policies nothing_saveable dots_saveable save_attn
+    python tools/aot_memory.py --model qwen3-1.7b --seq 2048 --sweep-gc
+
+Prints one JSON line per variant: argument/temp/output/alias bytes,
+estimated peak HBM, and fits_hbm for the generation's per-chip HBM.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_CHILD_ENV = "_SCALETORCH_TPU_AOT_CHILD"
+
+# Per-chip HBM by generation (utils/device.py carries FLOPS; memory here).
+HBM_GB = {"v5e": 16, "v6e": 32, "v5p": 95, "v4": 32}
+
+
+def _reexec_clean(argv: list[str]) -> int:
+    """Re-exec in a subprocess with the axon tunnel env scrubbed so the
+    local libtpu AOT plugin (not the remote-execution plugin) registers."""
+    env = dict(os.environ)
+    env[_CHILD_ENV] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # sitecustomize skips axon register
+    env.setdefault("TPU_WORKER_HOSTNAMES", "localhost")
+    env.setdefault("TPU_SKIP_MDS_QUERY", "1")
+    # No local devices in a compile-only session — device sniffing can't
+    # see the TPU target, so force the Pallas kernels on explicitly.
+    env.setdefault("SCALETORCH_TPU_FORCE_PALLAS", "1")
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)] + argv,
+                          env=env, cwd=REPO)
+    return proc.returncode
+
+
+def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
+                  gc: bool, remat_policy: str, gen: str):
+    """Lower the real SPMD train step for one topology chip, all-abstract."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import topologies
+
+    from scaletorch_tpu.benchmark import make_bench_args
+    from scaletorch_tpu.models import llama, qwen3_moe
+    from scaletorch_tpu.models.registry import resolve_attention_backend
+    from scaletorch_tpu.parallel.mesh import MeshManager
+    from scaletorch_tpu.parallel.spmd import make_spmd_train_step
+    from scaletorch_tpu.trainer.optimizer import create_optimizer
+    from scaletorch_tpu.trainer.trainer import build_model_config
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=f"{gen}:2x2x1")
+    cfg = make_bench_args(model, seq=seq, micro_bs=micro_bs,
+                          grad_accum=grad_accum, gc=gc,
+                          remat_policy=remat_policy)
+    model_cfg = build_model_config(cfg)
+    mm = MeshManager(devices=[topo.devices[0]], dp=1, pp=1, cp=1, ep=1, tp=1)
+
+    is_moe = cfg.model_type == "qwen3_moe"
+    mod = qwen3_moe if is_moe else llama
+    params = jax.eval_shape(lambda: mod.init_params(jax.random.key(0), model_cfg))
+    tx, _ = create_optimizer(cfg, include_clip=False)
+
+    step_fn, p_specs, o_specs = make_spmd_train_step(
+        mm, mod.forward, model_cfg, tx, params,
+        attention_backend=resolve_attention_backend(
+            cfg.attention_backend, context_parallel=False),
+        gradient_checkpointing=gc,
+        remat_policy=remat_policy,
+        max_grad_norm=cfg.max_grad_norm,
+        param_specs=(qwen3_moe.qwen3_moe_param_specs(model_cfg, tp_axis="tp")
+                     if is_moe else None),
+        model_kwargs={"ep_axis": None} if is_moe else None,
+        model_family="qwen3_moe" if is_moe else "llama",
+    )
+    opt_state = jax.eval_shape(tx.init, params)
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct(
+            (grad_accum, micro_bs, seq), jnp.int32),
+        "target_ids": jax.ShapeDtypeStruct(
+            (grad_accum, micro_bs, seq), jnp.int32),
+        "position_ids": jax.ShapeDtypeStruct((grad_accum, seq), jnp.int32),
+    }
+    return step_fn.lower(params, opt_state, batch)
+
+
+def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
+    lowered = build_lowered(
+        args_ns.model, seq=args_ns.seq, micro_bs=args_ns.bs,
+        grad_accum=args_ns.accum, gc=gc, remat_policy=remat_policy,
+        gen=args_ns.gen)
+    # XLA:TPU enforces the HBM budget at compile time (RESOURCE_EXHAUSTED
+    # on overflow), so a successful compile IS the fit verdict — the
+    # caller's except path records the failure. The size fields below are
+    # reported for composition analysis, not re-judged against a budget
+    # (donated-argument aliasing makes any client-side sum double-count).
+    compiled = lowered.compile()
+    m = compiled.memory_analysis()
+    arg = m.argument_size_in_bytes
+    peak = arg + m.temp_size_in_bytes + m.generated_code_size_in_bytes
+    return {
+        "model": args_ns.model, "seq": args_ns.seq, "bs": args_ns.bs,
+        "accum": args_ns.accum, "gc": gc, "remat_policy": remat_policy,
+        "gen": args_ns.gen,
+        "argument_gb": round(arg / 1e9, 3),
+        "temp_gb": round(m.temp_size_in_bytes / 1e9, 3),
+        "output_gb": round(m.output_size_in_bytes / 1e9, 3),
+        "alias_gb": round(m.alias_size_in_bytes / 1e9, 3),
+        "code_mb": round(m.generated_code_size_in_bytes / 1e6, 1),
+        "upper_bound_gb": round(peak / 1e9, 3),
+        "fits_hbm": True,
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="qwen3-0.6b")
+    ap.add_argument("--seq", type=int, default=8192)
+    ap.add_argument("--bs", type=int, default=1)
+    ap.add_argument("--accum", type=int, default=1)
+    ap.add_argument("--gc", action="store_true")
+    ap.add_argument("--gen", default="v5e", choices=sorted(HBM_GB))
+    ap.add_argument("--policies", nargs="*", default=None,
+                    help="remat policies to compare (implies --gc)")
+    ap.add_argument("--sweep-gc", action="store_true",
+                    help="compare gc off vs on")
+    args_ns = ap.parse_args()
+
+    if os.environ.get(_CHILD_ENV) != "1":
+        sys.exit(_reexec_clean(sys.argv[1:]))
+
+    variants = []
+    if args_ns.policies:
+        variants = [(True, p) for p in args_ns.policies]
+    elif args_ns.sweep_gc:
+        variants = [(False, "nothing_saveable"), (True, "nothing_saveable")]
+    else:
+        variants = [(args_ns.gc, "nothing_saveable")]
+
+    for gc, policy in variants:
+        try:
+            row = analyze(args_ns, gc=gc, remat_policy=policy)
+        except Exception as e:  # noqa: BLE001 — per-variant isolation
+            row = {"model": args_ns.model, "gc": gc, "remat_policy": policy,
+                   "error": repr(e)[:300]}
+            if "RESOURCE_EXHAUSTED" in row["error"]:
+                row["fits_hbm"] = False
+        print(json.dumps(row), flush=True)
+
+
+if __name__ == "__main__":
+    main()
