@@ -59,3 +59,6 @@ register_env("SCALETORCH_TPU_DISABLE_PALLAS", "0", _as_bool)  # force XLA fallba
 # AOT compile-only sessions (tools/aot_memory.py) have no local devices at
 # all, and remote-execution PJRT plugins may report a tunnel platform name.
 register_env("SCALETORCH_TPU_FORCE_PALLAS", "0", _as_bool)
+# Sequence-chunk length for the fused LM-head + cross-entropy (bounds the
+# live fp32 [B, C, V/tp] logits transient; halve on HBM-edge configs).
+register_env("SCALETORCH_TPU_CE_CHUNK", "1024", int)

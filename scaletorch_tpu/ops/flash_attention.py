@@ -28,16 +28,16 @@ def _pallas_available() -> bool:
         return False
     if get_env("SCALETORCH_TPU_FORCE_PALLAS"):
         return True
+    # is_tpu() recognises chips behind remote-execution PJRT plugins too —
+    # a bare ``platform == "tpu"`` check would silently drop REAL TPU
+    # hardware to the score-materialising SDPA fallback (34.6 GB of
+    # [L,B,H,S,S] scores at 0.6B/seq2048/bs2 per tools/aot_memory.py).
+    from scaletorch_tpu.utils.device import is_tpu
+
     try:
-        d = jax.local_devices()[0]
+        return is_tpu()
     except Exception:  # AOT compile-only session: no local devices
         return False
-    # Remote-execution PJRT plugins (device tunnels) expose TPU chips under
-    # their own platform name — ``platform == "tpu"`` alone would silently
-    # drop to the score-materialising SDPA fallback on REAL TPU hardware
-    # (34.6 GB of [L,B,H,S,S] scores at 0.6B/seq2048/bs2 per
-    # tools/aot_memory.py). Sniff the device kind too.
-    return d.platform == "tpu" or d.device_kind.startswith("TPU")
 
 
 def flash_attention(

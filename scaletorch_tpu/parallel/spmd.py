@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from scaletorch_tpu.env import get_env
 from scaletorch_tpu.parallel.mesh import DATA_AXES, MeshManager
 from scaletorch_tpu.parallel.tensor_parallel import (
     fused_vocab_parallel_cross_entropy,
@@ -43,7 +44,11 @@ from scaletorch_tpu.parallel.tensor_parallel import (
 
 def opt_state_specs(tx: optax.GradientTransformation, params: Any, param_specs: Any):
     """PartitionSpec tree for the optimizer state: params-like leaves (mu,
-    nu, ...) inherit the param's spec, scalars are replicated."""
+    nu, ...) inherit the param's spec, scalars are replicated. Optimizers
+    with non-param-shaped state (factored stats) publish their own layout
+    via a ``state_specs`` attribute (trainer/factored.py)."""
+    if hasattr(tx, "state_specs"):
+        return tx.state_specs(params)
     state_shape = jax.eval_shape(tx.init, params)
     return optax.tree_map_params(
         tx,
@@ -152,7 +157,8 @@ def _build_losses(
         # materialise (vocab-parallel over tp AND chunk-rematerialised).
         head = head_weight_fn(p, model_cfg, "tp")
         ce = fused_vocab_parallel_cross_entropy(
-            hidden, head, mb["target_ids"], axis="tp"
+            hidden, head, mb["target_ids"], axis="tp",
+            chunk_size=int(get_env("SCALETORCH_TPU_CE_CHUNK") or 1024),
         )
         return ce + aux, extras
 
@@ -461,10 +467,6 @@ def make_spmd_train_step(
             (loss, extras), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 p_v, mb
             )
-            # Match the scan path's fp32-gradient contract (cotangents are
-            # already fp32 for fp32 master params; this guards bf16-param
-            # trees so the reduce/clip/update below never run in bf16).
-            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
             loss = pvary_missing(loss, all_axes)
             extras = {k: pvary_missing(v, all_axes) for k, v in extras.items()}
         else:
@@ -485,6 +487,13 @@ def make_spmd_train_step(
             grads = jax.tree.map(lambda g: g / accum, grads)
             loss = loss_sum / accum
             extras = jax.tree.map(lambda v: jnp.mean(v, axis=0), extras_mb)
+
+        # fp32 gradient contract for EVERY path: the scan paths accumulate
+        # into fp32 zeros already, but the afab pipeline and the accum==1
+        # fast path hand back cotangents in param dtype — with bf16 master
+        # params that would run the reduction, global-norm, and clipping
+        # below in bf16. Promote once here (no-op when already fp32).
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
 
         # THE gradient reduction: mean over the fused data group (cp_dp_group
         # parity), plus a sum over tp/pp for model-replicated leaves whose
@@ -516,6 +525,11 @@ def make_spmd_train_step(
         else:
             grad_norm = global_grad_norm(grads, norm_axes)
 
+        # Hand the optimizer param-dtype gradients: reduction + clipping
+        # above ran in fp32 regardless, but bf16 master params (torch-parity
+        # param_dtype) need bf16 moments — fp32 grads would silently promote
+        # mu/nu to fp32 on the first update and break buffer donation.
+        grads = jax.tree.map(lambda g, w: g.astype(w.dtype), grads, p)
         updates, opt_state = tx.update(grads, opt_state, p)
         p = optax.apply_updates(p, updates)
         return p, opt_state, {"loss": loss, "grad_norm": grad_norm, **extras}

@@ -19,6 +19,8 @@ def create_optimizer(
     cfg,
     schedule: Optional[optax.Schedule] = None,
     include_clip: bool = True,
+    param_specs=None,
+    axis_sizes=None,
 ) -> Tuple[optax.GradientTransformation, optax.Schedule]:
     """cfg needs: optimizer_name, learning_rate, weight_decay, adam_beta1/2,
     adam_epsilon, max_grad_norm, momentum (+ scheduler fields if schedule
@@ -27,6 +29,12 @@ def create_optimizer(
     ``include_clip=False`` omits the clip-by-global-norm prologue — the
     SPMD train step applies its own tensor-parallel-correct clipping
     (parallel/spmd.py) and must not clip twice.
+
+    ``param_specs`` + ``axis_sizes`` (mesh-axis -> size) switch adafactor
+    to the sharding-aware implementation (trainer/factored.py) whose
+    factored statistics pmean across sharded parameter dims — required
+    whenever the train step runs under shard_map with tensor-parallel
+    leaves. Other optimizers ignore both.
     """
     if schedule is None:
         from scaletorch_tpu.trainer.lr_scheduler import create_lr_scheduler
@@ -57,6 +65,20 @@ def create_optimizer(
             weight_decay=cfg.weight_decay,
         )
     elif name == "adafactor":
+        if param_specs is not None:
+            from scaletorch_tpu.trainer.factored import adafactor_sharded
+
+            if include_clip:
+                raise ValueError(
+                    "sharded adafactor carries its own block-RMS clipping; "
+                    "use include_clip=False (the SPMD step's global-norm "
+                    "clip still applies)"
+                )
+            tx = adafactor_sharded(
+                schedule, param_specs, axis_sizes=axis_sizes,
+                weight_decay_rate=cfg.weight_decay or None,
+            )
+            return tx, schedule
         tx = optax.adafactor(schedule)
     else:
         raise ValueError(f"unknown optimizer {cfg.optimizer_name!r}")

@@ -95,6 +95,9 @@ def make_train_step(
     def train_step(params, opt_state, batch):
         loss, grads = accumulate_gradients(loss_fn, params, batch)
         grad_norm = optax.global_norm(grads)
+        # Param-dtype grads into the optimizer so bf16 master params keep
+        # bf16 moments (same contract as the SPMD step, parallel/spmd.py).
+        grads = jax.tree.map(lambda g, w: g.astype(w.dtype), grads, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {"loss": loss, "grad_norm": grad_norm}

@@ -34,7 +34,7 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
     from scaletorch_tpu.models import qwen3_moe
 
     dtype = _DTYPE[cfg.dtype]
-    overrides = dict(dtype=dtype)
+    overrides = dict(dtype=dtype, param_dtype=_DTYPE[cfg.param_dtype])
     if cfg.model_name_or_path:
         from transformers import AutoConfig
 
@@ -250,8 +250,28 @@ class Trainer:
             with jax.default_device(jax.local_devices()[0]):
                 params_host = init_fn(key, self.model_cfg)
 
-        # clip-free optimizer: the SPMD step applies TP-correct clipping
-        self.tx, self.schedule = create_optimizer(cfg, include_clip=False)
+        # clip-free optimizer: the SPMD step applies TP-correct clipping.
+        # Adafactor additionally needs the param layout + mesh sizes so its
+        # factored statistics reduce across sharded dims (trainer/factored.py).
+        if cfg.optimizer_name.lower() == "adafactor":
+            if param_specs is not None:
+                opt_specs_in = param_specs
+            else:
+                from scaletorch_tpu.parallel.tensor_parallel import (
+                    llama_param_specs,
+                )
+
+                opt_specs_in = llama_param_specs(
+                    self.model_cfg,
+                    tp_axis="tp",
+                    pp_axis="pp" if cfg.pipeline_parallel_size > 1 else None,
+                )
+            self.tx, self.schedule = create_optimizer(
+                cfg, include_clip=False, param_specs=opt_specs_in,
+                axis_sizes=dict(self.mm.mesh.shape),
+            )
+        else:
+            self.tx, self.schedule = create_optimizer(cfg, include_clip=False)
 
         self.step_fn, p_specs, o_specs = make_spmd_train_step(
             self.mm,
@@ -307,6 +327,7 @@ class Trainer:
         self._eval_fn = None
         self._eval_loader = None
         self._eval_batches = None
+        self._eval_iter = None
         if cfg.eval_frequency:
             from scaletorch_tpu.parallel.spmd import make_spmd_eval_step
 
@@ -382,15 +403,19 @@ class Trainer:
         if self._eval_batches is None:
             self._eval_batches = []
         if len(self._eval_batches) < num_batches:
-            # EXTEND the cached set rather than rebuilding: loaders share a
-            # mutable stream position (iter() continues, it does not
-            # restart), so a rebuild would re-draw the already-cached
-            # prefix from an advanced stream and break the fixed-eval-set
-            # contract for earlier val_loss readings. Extending keeps the
-            # prefix bit-identical and pins the new draws alongside it.
-            it = iter(self._eval_loader)
+            # EXTEND the cached set from ONE persistent iterator rather
+            # than rebuilding: a rebuild re-draws the cached prefix
+            # (synthetic loaders share a mutable rng; file-backed loaders
+            # restart their epoch permutation on re-iteration), breaking
+            # the fixed-eval-set contract for earlier val_loss readings
+            # either by drift or by duplication. A single live iterator
+            # keeps the prefix bit-identical and serves fresh batches for
+            # the extension under both semantics.
+            if self._eval_iter is None:
+                self._eval_iter = iter(self._eval_loader)
             self._eval_batches.extend(
-                next(it) for _ in range(num_batches - len(self._eval_batches))
+                next(self._eval_iter)
+                for _ in range(num_batches - len(self._eval_batches))
             )
         total = 0.0
         for batch in self._eval_batches[:num_batches]:

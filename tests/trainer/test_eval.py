@@ -84,3 +84,45 @@ def test_trainer_evaluate_synthetic():
     assert val == pytest.approx(np.log(128), rel=0.2)
     # the train loop logs val_loss without erroring
     tr.train(num_steps=1)
+
+
+def test_trainer_bf16_master_weights():
+    """param_dtype=bfloat16 (torch-parity memory mode, bench 1.7B/4B rows):
+    params AND adam moments stay bf16 across jitted steps — a dtype drift
+    would change the jit signature / break donation — and loss decreases."""
+    import jax.numpy as jnp
+
+    cfg = ScaleTorchTPUArguments(
+        model_type="llama", hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, vocab_size=128, sequence_length=16,
+        max_position_embeddings=64, learning_rate=3e-3,
+        data_parallel_size=4, tensor_parallel_size=2,
+        synthetic_data=True, total_train_steps=12,
+        dtype="bfloat16", param_dtype="bfloat16",
+        donate_params=False, log_frequency=100,
+        eval_frequency=1000, eval_steps=2,
+    )
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    tr = Trainer(cfg)
+    assert all(p.dtype == jnp.bfloat16 for p in jax.tree.leaves(tr.params))
+    p0 = jax.tree.map(lambda x: np.asarray(x, np.float32), tr.params)
+    tr.train(num_steps=12)
+    val = tr.evaluate()
+    # dtype stability across jitted steps (a drift would respecialise the
+    # jit signature / break donation)
+    assert all(p.dtype == jnp.bfloat16 for p in jax.tree.leaves(tr.params))
+    # adam mu/nu inherit the bf16 param dtype (param-shaped leaves only —
+    # the step counter and schedule state stay scalar int/fp32)
+    mu_like = [
+        o for o in jax.tree.leaves(tr.opt_state)
+        if getattr(o, "ndim", 0) >= 1 and o.size > 4
+    ]
+    assert mu_like and all(o.dtype == jnp.bfloat16 for o in mu_like)
+    assert val is not None and np.isfinite(val)
+    moved = [
+        float(np.abs(np.asarray(b, np.float32) - a).max())
+        for a, b in zip(jax.tree.leaves(p0), jax.tree.leaves(tr.params))
+    ]
+    assert max(moved) > 0

@@ -54,7 +54,8 @@ def _reexec_clean(argv: list[str]) -> int:
 
 
 def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
-                  gc: bool, remat_policy: str, gen: str):
+                  gc: bool, remat_policy: str, gen: str,
+                  param_dtype: str = "float32", optimizer: str = "adamw"):
     """Lower the real SPMD train step for one topology chip, all-abstract."""
     import jax
     import jax.numpy as jnp
@@ -72,14 +73,27 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
         platform="tpu", topology_name=f"{gen}:2x2x1")
     cfg = make_bench_args(model, seq=seq, micro_bs=micro_bs,
                           grad_accum=grad_accum, gc=gc,
-                          remat_policy=remat_policy)
+                          remat_policy=remat_policy,
+                          extra={"param_dtype": param_dtype,
+                                 "optimizer_name": optimizer})
     model_cfg = build_model_config(cfg)
     mm = MeshManager(devices=[topo.devices[0]], dp=1, pp=1, cp=1, ep=1, tp=1)
 
     is_moe = cfg.model_type == "qwen3_moe"
     mod = qwen3_moe if is_moe else llama
     params = jax.eval_shape(lambda: mod.init_params(jax.random.key(0), model_cfg))
-    tx, _ = create_optimizer(cfg, include_clip=False)
+    if cfg.optimizer_name.lower() == "adafactor":
+        from scaletorch_tpu.parallel.tensor_parallel import llama_param_specs
+
+        tx, _ = create_optimizer(
+            cfg, include_clip=False,
+            param_specs=(qwen3_moe.qwen3_moe_param_specs(model_cfg, tp_axis="tp")
+                         if is_moe else
+                         llama_param_specs(model_cfg, tp_axis="tp")),
+            axis_sizes=dict(mm.mesh.shape),
+        )
+    else:
+        tx, _ = create_optimizer(cfg, include_clip=False)
 
     step_fn, p_specs, o_specs = make_spmd_train_step(
         mm, mod.forward, model_cfg, tx, params,
@@ -108,7 +122,8 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
     lowered = build_lowered(
         args_ns.model, seq=args_ns.seq, micro_bs=args_ns.bs,
         grad_accum=args_ns.accum, gc=gc, remat_policy=remat_policy,
-        gen=args_ns.gen)
+        gen=args_ns.gen, param_dtype=args_ns.param_dtype,
+        optimizer=args_ns.optimizer)
     # XLA:TPU enforces the HBM budget at compile time (RESOURCE_EXHAUSTED
     # on overflow), so a successful compile IS the fit verdict — the
     # caller's except path records the failure. The size fields below are
@@ -121,7 +136,7 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
     return {
         "model": args_ns.model, "seq": args_ns.seq, "bs": args_ns.bs,
         "accum": args_ns.accum, "gc": gc, "remat_policy": remat_policy,
-        "gen": args_ns.gen,
+        "gen": args_ns.gen, "param_dtype": args_ns.param_dtype,
         "argument_gb": round(arg / 1e9, 3),
         "temp_gb": round(m.temp_size_in_bytes / 1e9, 3),
         "output_gb": round(m.output_size_in_bytes / 1e9, 3),
@@ -140,6 +155,9 @@ def main() -> None:
     ap.add_argument("--accum", type=int, default=1)
     ap.add_argument("--gc", action="store_true")
     ap.add_argument("--gen", default="v5e", choices=sorted(HBM_GB))
+    ap.add_argument("--param-dtype", default="float32",
+                    choices=["float32", "bfloat16"])
+    ap.add_argument("--optimizer", default="adamw")
     ap.add_argument("--policies", nargs="*", default=None,
                     help="remat policies to compare (implies --gc)")
     ap.add_argument("--sweep-gc", action="store_true",
