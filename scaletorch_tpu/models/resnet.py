@@ -1,0 +1,176 @@
+"""Functional ResNet (18/34, basic blocks) — the reference imagenet
+example's model family (examples/torch_examples/imagenet/dist_train.py:24-44
+``torchvision.models`` resnet18 default), TPU-native: NHWC layout,
+``lax.conv_general_dilated`` (channels-last is the MXU-friendly layout),
+BatchNorm as explicit functional state threaded through ``forward`` —
+train mode computes batch statistics over the WHOLE (possibly
+mesh-sharded) batch and returns updated running stats; eval mode
+consumes the running stats. Under GSPMD with the batch sharded over a
+data axis, the stat reductions become cross-device all-reduces — i.e.
+sync-BN (torch's SyncBatchNorm), not DDP's default local-BN: stats are
+batch-size-exact regardless of the device count.
+
+Static Python loops over blocks (8 for r18, 16 for r34) — shapes differ
+per stage, so a ``lax.scan`` over stacked layers (the LLM trick) does not
+apply; XLA unrolls and fuses the short chain.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+_DEPTHS = {18: (2, 2, 2, 2), 34: (3, 4, 6, 3)}
+
+
+@dataclass
+class ResNetConfig:
+    depth: int = 18
+    num_classes: int = 1000
+    width: int = 64          # stem channels; stages use width * (1,2,4,8)
+    image_size: int = 224
+    bn_momentum: float = 0.1
+    bn_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def stage_blocks(self) -> Tuple[int, ...]:
+        if self.depth not in _DEPTHS:
+            raise ValueError(f"depth must be one of {sorted(_DEPTHS)}")
+        return _DEPTHS[self.depth]
+
+    def num_params(self) -> int:
+        return sum(p.size for p in jax.tree.leaves(
+            init_params(jax.random.key(0), self)[0]))
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    # Kaiming-normal fan_out (torchvision resnet init)
+    std = (2.0 / (kh * kw * cout)) ** 0.5
+    return std * jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+
+
+def _bn_params(c):
+    return {"scale": jnp.ones((c,)), "bias": jnp.zeros((c,))}
+
+
+def _bn_state(c):
+    return {"mean": jnp.zeros((c,)), "var": jnp.ones((c,))}
+
+
+def init_params(key: jax.Array, cfg: ResNetConfig) -> Tuple[Params, Params]:
+    """(params, bn_state) for the functional forward."""
+    keys = iter(jax.random.split(key, 128))
+    w = cfg.width
+    params: Params = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, w),
+                               "bn": _bn_params(w)}}
+    state: Params = {"stem": _bn_state(w)}
+    cin = w
+    for si, nblocks in enumerate(cfg.stage_blocks):
+        cout = w * (2 ** si)
+        blocks: List[Params] = []
+        bstates: List[Params] = []
+        for bi in range(nblocks):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            blk = {
+                "conv1": _conv_init(next(keys), 3, 3, cin, cout),
+                "bn1": _bn_params(cout),
+                "conv2": _conv_init(next(keys), 3, 3, cout, cout),
+                "bn2": _bn_params(cout),
+            }
+            bst = {"bn1": _bn_state(cout), "bn2": _bn_state(cout)}
+            if stride != 1 or cin != cout:
+                blk["down_conv"] = _conv_init(next(keys), 1, 1, cin, cout)
+                blk["down_bn"] = _bn_params(cout)
+                bst["down_bn"] = _bn_state(cout)
+            blocks.append(blk)
+            bstates.append(bst)
+            cin = cout
+        params[f"stage{si}"] = blocks
+        state[f"stage{si}"] = bstates
+    fc_in = w * 8
+    bound = 1.0 / fc_in ** 0.5
+    params["fc"] = {
+        "kernel": jax.random.uniform(next(keys), (fc_in, cfg.num_classes),
+                                     jnp.float32, -bound, bound),
+        "bias": jnp.zeros((cfg.num_classes,)),
+    }
+    return params, state
+
+
+def _conv(x, w, stride=1, dtype=jnp.float32):
+    return jax.lax.conv_general_dilated(
+        x.astype(dtype), w.astype(dtype),
+        window_strides=(stride, stride),
+        padding="SAME" if w.shape[0] > 1 else "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _bn(x, p, s, cfg, train: bool):
+    """Returns (y, new_state). Train: batch stats over the full (global)
+    batch — sync-BN under a sharded mesh — + fp32 EMA of running stats."""
+    x32 = x.astype(jnp.float32)
+    if train:
+        mean = jnp.mean(x32, axis=(0, 1, 2))
+        var = jnp.var(x32, axis=(0, 1, 2))
+        m = cfg.bn_momentum
+        new_s = {"mean": (1 - m) * s["mean"] + m * mean,
+                 "var": (1 - m) * s["var"] + m * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    y = (x32 - mean) * jax.lax.rsqrt(var + cfg.bn_eps)
+    y = y * p["scale"] + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def forward(
+    params: Params,
+    state: Params,
+    images: jax.Array,
+    cfg: ResNetConfig,
+    *,
+    train: bool = True,
+) -> Tuple[jax.Array, Params]:
+    """images [N, H, W, 3] -> (logits [N, classes], new_bn_state)."""
+    x = images.astype(cfg.dtype)
+    new_state: Params = {}
+    x = _conv(x, params["stem"]["conv"], stride=2, dtype=cfg.dtype)
+    x, new_state["stem"] = _bn(x, params["stem"]["bn"], state["stem"],
+                               cfg, train)
+    x = jax.nn.relu(x)
+    # 3x3 stride-2 max pool
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME"
+    )
+    for si in range(len(cfg.stage_blocks)):
+        blocks = params[f"stage{si}"]
+        bstates = state[f"stage{si}"]
+        new_bstates = []
+        for bi, (blk, bst) in enumerate(zip(blocks, bstates)):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            nst = {}
+            out = _conv(x, blk["conv1"], stride=stride, dtype=cfg.dtype)
+            out, nst["bn1"] = _bn(out, blk["bn1"], bst["bn1"], cfg, train)
+            out = jax.nn.relu(out)
+            out = _conv(out, blk["conv2"], stride=1, dtype=cfg.dtype)
+            out, nst["bn2"] = _bn(out, blk["bn2"], bst["bn2"], cfg, train)
+            if "down_conv" in blk:
+                identity = _conv(x, blk["down_conv"], stride=stride,
+                                 dtype=cfg.dtype)
+                identity, nst["down_bn"] = _bn(
+                    identity, blk["down_bn"], bst["down_bn"], cfg, train)
+            else:
+                identity = x
+            x = jax.nn.relu(out + identity)
+            new_bstates.append(nst)
+        new_state[f"stage{si}"] = new_bstates
+    x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))  # global avg pool
+    logits = x @ params["fc"]["kernel"] + params["fc"]["bias"]
+    return logits, new_state

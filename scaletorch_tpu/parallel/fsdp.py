@@ -121,13 +121,14 @@ def setup_fsdp(
 ) -> Tuple[Callable, Any, Any, Mesh]:
     """One-call wiring: (step_fn, sharded_params, sharded_opt_state, mesh).
 
-    The optimizer state is PLACED explicitly into the param-inherited
-    shardings — no rank ever holds a full mu/nu copy (the ZeRO-1
-    property, on top of ZeRO-3 params). Explicit placement matters:
-    ``jit(tx.init)`` outputs have no data dependence on the params (only
-    their shapes), so XLA parks them on the default device as
-    uncommitted arrays — that happens to run, but any later COMMITTED
-    state (e.g. an orbax restore) then fails jit's mixed-devices check.
+    The optimizer state is initialised directly INTO its shardings via
+    ``jit(tx.init, out_shardings=...)`` — no rank ever materialises a
+    full mu/nu copy, not even transiently during setup (the ZeRO-1
+    property, on top of ZeRO-3 params). The explicit out_shardings also
+    COMMITS the state: a bare ``jit(tx.init)``'s outputs have no data
+    dependence on the params, land uncommitted on the default device,
+    and then fail jit's mixed-devices check the first time a committed
+    tree (e.g. an orbax restore) replaces them.
     """
     from scaletorch_tpu.parallel.spmd import opt_state_specs
 
@@ -135,7 +136,11 @@ def setup_fsdp(
     specs = fsdp_param_specs(params_host, mesh.shape[axis], axis)
     params = shard_params_fsdp(mesh, params_host, specs)
     o_specs = opt_state_specs(tx, params_host, specs)
-    opt_state = shard_params_fsdp(mesh, tx.init(params_host), o_specs)
+    o_shardings = jax.tree.map(
+        lambda s: NamedSharding(mesh, s), o_specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+    opt_state = jax.jit(tx.init, out_shardings=o_shardings)(params)
     step_fn = make_fsdp_train_step(
         forward, model_cfg, tx, mesh, axis=axis, **step_kwargs
     )
