@@ -10,8 +10,8 @@ statistics reduce over the GLOBAL batch (sync-BN — torch's
 SyncBatchNorm rather than DDP's local default, models/resnet.py), so
 training dynamics are independent of the device count.
 
-Data: an ImageFolder-style directory of per-class .npy/.npz arrays if
---data is given, else a deterministic synthetic stand-in (fixed class
+Data: an ImageFolder-style directory of per-class .npy arrays if --data
+is given, else a deterministic synthetic stand-in (fixed class
 prototypes + noise) so the example is hermetic offline.
 
     PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \

@@ -62,3 +62,9 @@ register_env("SCALETORCH_TPU_FORCE_PALLAS", "0", _as_bool)
 # Sequence-chunk length for the fused LM-head + cross-entropy (bounds the
 # live fp32 [B, C, V/tp] logits transient; halve on HBM-edge configs).
 register_env("SCALETORCH_TPU_CE_CHUNK", "1024", int)
+# Flash-kernel tile sizes (ops/pallas/flash.py). The defaults are sound
+# for d=64..128 on v5e VMEM; tools/optimize_mfu.py --flash-blocks sweeps
+# these on the actual chip (block choice is a measured property, not a
+# host-side heuristic).
+register_env("SCALETORCH_TPU_FLASH_BLOCK_Q", "512", int)
+register_env("SCALETORCH_TPU_FLASH_BLOCK_KV", "512", int)

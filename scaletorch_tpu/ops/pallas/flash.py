@@ -40,6 +40,21 @@ from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_KV = 512
+
+
+def _resolve_blocks(block_q, block_kv):
+    """None -> the SCALETORCH_TPU_FLASH_BLOCK_Q/KV env registry values
+    (tools/optimize_mfu.py --flash-blocks sweeps these on the real chip).
+    Resolved HERE so every entry point — the attention backend, the ring
+    attention's forward/backward composition — honours the tuned tiles."""
+    if block_q is None or block_kv is None:
+        from scaletorch_tpu.env import get_env
+
+        block_q = block_q or get_env("SCALETORCH_TPU_FLASH_BLOCK_Q")
+        block_kv = block_kv or get_env("SCALETORCH_TPU_FLASH_BLOCK_KV")
+    return block_q, block_kv
+
+
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 
 
@@ -357,8 +372,8 @@ def pallas_flash_attention(
     *,
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_kv: int = DEFAULT_BLOCK_KV,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """q: [B, Hq, S, D]; k/v: [B, Hkv, Skv, D]; Hq % Hkv == 0 (GQA)."""
@@ -368,6 +383,7 @@ def pallas_flash_attention(
         raise ValueError(f"query heads {hq} not a multiple of kv heads {hkv}")
     if scale is None:
         scale = 1.0 / math.sqrt(d)
+    block_q, block_kv = _resolve_blocks(block_q, block_kv)
     bq = _pick_block(sq, block_q)
     bkv = _pick_block(skv, block_kv)
     return _flash(q, k, v, causal, scale, bq, bkv, interpret)
@@ -383,8 +399,8 @@ def flash_forward_with_lse(
     *,
     causal: bool,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_kv: int = DEFAULT_BLOCK_KV,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool = False,
 ):
     """Raw kernel forward returning ``(out, lse)``.
@@ -400,6 +416,7 @@ def flash_forward_with_lse(
         )
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_kv = _resolve_blocks(block_q, block_kv)
     bq = _pick_block(q.shape[2], block_q)
     bkv = _pick_block(k.shape[2], block_kv)
     return _flash_forward(q, k, v, causal, scale, bq, bkv, interpret)
@@ -415,8 +432,8 @@ def flash_block_backward(
     *,
     causal: bool,
     scale: Optional[float] = None,
-    block_q: int = DEFAULT_BLOCK_Q,
-    block_kv: int = DEFAULT_BLOCK_KV,
+    block_q: int | None = None,
+    block_kv: int | None = None,
     interpret: bool = False,
 ):
     """Gradients of one K/V block against a GLOBAL softmax statistic.
@@ -434,6 +451,7 @@ def flash_block_backward(
         )
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    block_q, block_kv = _resolve_blocks(block_q, block_kv)
     bq = _pick_block(q.shape[2], block_q)
     bkv = _pick_block(k.shape[2], block_kv)
     return _flash_backward(q, k, v, out, lse, dout, causal, scale, bq, bkv,

@@ -157,6 +157,13 @@ class Trainer:
             import logging
 
             self.logger.setLevel(logging.ERROR)
+        if cfg.verbose:
+            # AFTER init_distributed: get_system_info touches jax.devices(),
+            # and any backend touch before jax.distributed.initialize would
+            # pin this process to its local devices only (dist.py:100-110).
+            from scaletorch_tpu.utils.env_info import log_system_info
+
+            log_system_info(self.logger)
         cfg.validate_world_size(len(jax.devices()))
         self.mm: MeshManager = setup_mesh_manager(**cfg.mesh_kwargs())
         self.model_cfg = build_model_config(cfg)

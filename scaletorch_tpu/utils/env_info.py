@@ -1,0 +1,82 @@
+"""System + accelerator diagnostics.
+
+Parity with reference scaletorch/utils/env_utils.py:61-130
+(``get_system_info``: OS/python/cpu/memory/disk/hostname plus a
+device-type block per backend). The TPU block reports what matters for
+debugging a JAX run: platform, device kind and count, per-chip HBM from
+live memory stats, the FLOPS-registry entry MFU is normalised against,
+and jax/jaxlib versions.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import socket
+from typing import Any, Dict
+
+
+def get_system_info() -> Dict[str, Any]:
+    info: Dict[str, Any] = {
+        "Operating System": platform.platform(),
+        "Python Version": platform.python_version(),
+        "Hostname": socket.gethostname(),
+        "CPU Count": os.cpu_count(),
+    }
+    try:  # psutil is diagnostics-only, not a package dependency
+        import psutil
+
+        vm = psutil.virtual_memory()
+        du = psutil.disk_usage("/")
+        info.update({
+            "CPU Physical Count": psutil.cpu_count(logical=False),
+            "Memory Total": f"{vm.total / 1024**3:.2f}GB",
+            "Memory Available": f"{vm.available / 1024**3:.2f}GB",
+            "Disk Usage":
+                f"{du.used / 1024**3:.2f}GB / {du.total / 1024**3:.2f}GB",
+        })
+    except ImportError:
+        info["Memory Total"] = "unknown (psutil not installed)"
+
+    try:
+        import jax
+
+        from scaletorch_tpu.utils.device import (
+            device_memory_stats,
+            get_theoretical_flops,
+            is_tpu,
+        )
+
+        info["JAX Version"] = jax.__version__
+        import jaxlib
+
+        info["jaxlib Version"] = getattr(jaxlib, "__version__", "unknown")
+        devs = jax.devices()
+        d0 = devs[0]
+        info["Device Type"] = "TPU" if is_tpu() else d0.platform.upper()
+        info["Device Kind"] = d0.device_kind
+        info["Device Count"] = len(devs)
+        info["Local Device Count"] = len(jax.local_devices())
+        info["Process Count"] = jax.process_count()
+        stats = device_memory_stats()
+        if stats.get("bytes_limit"):
+            info["Device Memory"] = f"{stats['bytes_limit'] / 1024**3:.2f}GB"
+        try:
+            info["Peak bf16 TFLOPS (registry)"] = (
+                get_theoretical_flops(d0) / 1e12
+            )
+        except Exception:  # unknown chip: MFU falls back to env override
+            pass
+        info["BF16 Support"] = True  # native on every TPU gen; CPU via XLA
+    except Exception as exc:  # pre-backend-init or headless call sites
+        info["Device Type"] = f"unavailable ({type(exc).__name__})"
+    return info
+
+
+def log_system_info(logger) -> Dict[str, Any]:
+    """Log one 'k: v' line per entry (reference env_utils.py:67,129-130)."""
+    info = get_system_info()
+    logger.info("System Diagnostic Information:")
+    for k, v in info.items():
+        logger.info(f"  {k}: {v}")
+    return info

@@ -1,0 +1,25 @@
+"""System diagnostics (utils/env_info.py, reference env_utils.py parity)."""
+
+from __future__ import annotations
+
+import logging
+
+from scaletorch_tpu.utils.env_info import get_system_info, log_system_info
+
+
+def test_get_system_info_core_fields():
+    info = get_system_info()
+    for key in ("Operating System", "Python Version", "CPU Count",
+                "Memory Total", "Hostname", "Device Type", "Device Count",
+                "JAX Version"):
+        assert key in info, key
+    assert info["Device Count"] >= 1
+    assert info["BF16 Support"] is True
+
+
+def test_log_system_info_emits_lines(caplog):
+    logger = logging.getLogger("env_info_test")
+    with caplog.at_level(logging.INFO, logger="env_info_test"):
+        info = log_system_info(logger)
+    assert "System Diagnostic Information:" in caplog.text
+    assert str(info["Device Count"]) in caplog.text

@@ -39,7 +39,24 @@ def main() -> None:
     ap.add_argument("--try_no_gc", action="store_true",
                     help="also try gradient_checkpointing off")
     ap.add_argument("--out", default=None, help="write results JSON here")
+    ap.add_argument("--flash-blocks", nargs="*", default=None,
+                    metavar="BQxBKV",
+                    help="also sweep flash tile sizes on the best "
+                         "gc/batch point, e.g. 256x512 512x512 512x1024 "
+                         "(sets SCALETORCH_TPU_FLASH_BLOCK_Q/KV per run)")
     args = ap.parse_args()
+
+    # Validate BEFORE the expensive sweeps: a typo'd spec must not crash
+    # the run after minutes of completed benchmarks.
+    flash_blocks = []
+    for spec in args.flash_blocks or []:
+        try:
+            bq, bkv = (int(x) for x in spec.lower().split("x"))
+        except ValueError:
+            raise SystemExit(
+                f"--flash-blocks entry {spec!r} is not BQxBKV (e.g. 512x512)"
+            )
+        flash_blocks.append((bq, bkv))
 
     from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
 
@@ -69,6 +86,29 @@ def main() -> None:
             _gc.collect()
 
     ok = [r for r in results if "mfu" in r]
+    if ok and flash_blocks:
+        # Tile-size sweep on the winning shape: the kernel reads the env
+        # registry at trace time, so each variant re-jits with its tiles.
+        best_label = max(ok, key=lambda r: r["mfu"])["label"]
+        best_shape = next(v for label, v in variants if label == best_label)
+        for bq, bkv in flash_blocks:
+            os.environ["SCALETORCH_TPU_FLASH_BLOCK_Q"] = str(bq)
+            os.environ["SCALETORCH_TPU_FLASH_BLOCK_KV"] = str(bkv)
+            label = f"flash_{bq}x{bkv}"
+            try:
+                cfg = make_bench_args(args.model, seq=args.seq, **best_shape)
+                r = benchmark_config(cfg, warmup=args.warmup, steps=args.steps)
+                results.append({"label": label, **r})
+                print(f"{label:<28} MFU {r['mfu']:6.2f}%  "
+                      f"tok/s {r['tokens_per_second']:>10,.0f}", flush=True)
+            except Exception as e:  # noqa: BLE001
+                status = "OOM" if any(m in repr(e) for m in _OOM) else "FAILED"
+                results.append({"label": label, "error": status})
+                print(f"{label:<28} {status}", flush=True)
+                _gc.collect()
+        for v in ("SCALETORCH_TPU_FLASH_BLOCK_Q", "SCALETORCH_TPU_FLASH_BLOCK_KV"):
+            os.environ.pop(v, None)
+        ok = [r for r in results if "mfu" in r]
     if ok:
         best = max(ok, key=lambda r: r["mfu"])
         print(f"\nbest: {best['label']} at {best['mfu']}% MFU "
