@@ -38,10 +38,6 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_KV = 512
-
-
 def _resolve_blocks(block_q, block_kv):
     """None -> the SCALETORCH_TPU_FLASH_BLOCK_Q/KV env registry values
     (tools/optimize_mfu.py --flash-blocks sweeps these on the real chip).

@@ -67,7 +67,9 @@ def get_system_info() -> Dict[str, Any]:
             )
         except Exception:  # unknown chip: MFU falls back to env override
             pass
-        info["BF16 Support"] = True  # native on every TPU gen; CPU via XLA
+        from scaletorch_tpu.utils.device import bf16_supported
+
+        info["BF16 Support"] = bf16_supported()
     except Exception as exc:  # pre-backend-init or headless call sites
         info["Device Type"] = f"unavailable ({type(exc).__name__})"
     return info
