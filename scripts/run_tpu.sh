@@ -1,0 +1,94 @@
+#!/bin/bash
+set -e
+
+# scaletorch-tpu optimized training launch — counterpart of reference
+# scripts/run_npu.sh (mode-based operating points + accelerator env
+# tuning). The HCCL knobs map to nothing on TPU (XLA owns collective
+# scheduling); what remains tunable is precision, remat policy, the
+# fused-CE chunk, and flash tile sizes.
+#
+# Usage: bash scripts/run_tpu.sh [NUM_CHIPS] [MODEL_PATH] [DATASET] [MODE]
+#
+# MODE options (per-chip shapes; reference run_npu.sh measured table):
+#   max_mfu    - SEQ=16384, BS=1, GC        (maximize compute utilization)
+#   max_speed  - SEQ=2048,  BS=4, GA=2      (max tokens/s; GC only if HBM-tight)
+#   balanced   - SEQ=8192,  BS=2, GC
+#   min_mem    - SEQ=2048,  BS=4, GC + bf16 master weights + save_attn remat
+
+NUM_CHIPS=${1:-8}
+MODEL_PATH=${2:-""}
+DATASET=${3:-""}
+MODE=${4:-"balanced"}
+
+# === TPU performance env (scaletorch_tpu/env.py registry) ===
+export DTYPE=bfloat16
+export FLASH_ATTEN=1
+export XLA_PYTHON_CLIENT_MEM_FRACTION=${XLA_PYTHON_CLIENT_MEM_FRACTION:-0.92}
+
+PARAM_DTYPE=float32
+REMAT=nothing_saveable
+case "$MODE" in
+  max_mfu)
+    MICRO_BS=1; SEQ_LEN=16384; GRAD_ACCUM=1; GC=true
+    ;;
+  max_speed)
+    MICRO_BS=4; SEQ_LEN=2048;  GRAD_ACCUM=2; GC=false
+    ;;
+  min_mem)
+    MICRO_BS=4; SEQ_LEN=2048;  GRAD_ACCUM=1; GC=true
+    PARAM_DTYPE=bfloat16; REMAT=save_attn
+    export SCALETORCH_TPU_CE_CHUNK=512
+    ;;
+  balanced|*)
+    MICRO_BS=2; SEQ_LEN=8192;  GRAD_ACCUM=1; GC=true
+    ;;
+esac
+
+DP_SIZE=${DP_SIZE:-$NUM_CHIPS}; TP_SIZE=${TP_SIZE:-1}
+PP_SIZE=${PP_SIZE:-1}; CP_SIZE=${CP_SIZE:-1}
+GLOBAL_TOK=$((MICRO_BS * SEQ_LEN * GRAD_ACCUM * DP_SIZE))
+
+echo "============================================"
+echo " scaletorch-tpu training  [mode: $MODE]"
+echo " chips: ${NUM_CHIPS}, dp=${DP_SIZE} tp=${TP_SIZE} pp=${PP_SIZE} cp=${CP_SIZE}"
+echo " BS=${MICRO_BS} x GA=${GRAD_ACCUM} x SEQ=${SEQ_LEN}"
+echo " GC=${GC} remat=${REMAT} param_dtype=${PARAM_DTYPE}"
+echo " Global tokens/step=${GLOBAL_TOK}"
+echo "============================================"
+
+cd "$(dirname "$0")/.."
+
+MODEL_ARGS=()
+if [ -n "$MODEL_PATH" ]; then
+  MODEL_ARGS+=(--model_name_or_path "$MODEL_PATH" --load_pretrained_weights true)
+else
+  MODEL_ARGS+=(--model_type qwen3)  # preset-sized synthetic run
+fi
+DATA_ARGS=()
+if [ -n "$DATASET" ]; then
+  DATA_ARGS+=(--dataset_name "$DATASET")
+else
+  DATA_ARGS+=(--synthetic_data true)
+fi
+
+exec python train.py \
+    "${MODEL_ARGS[@]}" \
+    "${DATA_ARGS[@]}" \
+    --tensor_parallel_size ${TP_SIZE} \
+    --pipeline_parallel_size ${PP_SIZE} \
+    --data_parallel_size ${DP_SIZE} \
+    --context_parallel_size ${CP_SIZE} \
+    --micro_batch_size ${MICRO_BS} \
+    --gradient_accumulation_steps ${GRAD_ACCUM} \
+    --sequence_length ${SEQ_LEN} \
+    --gradient_checkpointing ${GC} \
+    --remat_policy ${REMAT} \
+    --param_dtype ${PARAM_DTYPE} \
+    --learning_rate 3e-4 \
+    --max_grad_norm 1.0 \
+    --lr_scheduler_type cosine \
+    --warmup_steps 100 \
+    --save_frequency 500 \
+    --log_frequency 10 \
+    --seed 42 \
+    "${@:5}"

@@ -57,7 +57,7 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
                   gc: bool, remat_policy: str, gen: str,
                   param_dtype: str = "float32", optimizer: str = "adamw",
                   dp: int = 1, tp: int = 1, cp: int = 1, pp: int = 1,
-                  ep: int = 1, sp: bool = False):
+                  ep: int = 1, sp: bool = False, pp_engine: str = "afab"):
     """Lower the real SPMD train step against an AOT TPU topology —
     single chip by default, or a multi-chip mesh factoring (dp/tp/cp/pp/
     ep over the 4-chip v5e host topology): Mosaic kernel compilation for
@@ -84,6 +84,7 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
                           grad_accum=grad_accum, gc=gc,
                           remat_policy=remat_policy,
                           dp=dp, tp=tp, cp=cp, pp=pp, ep=ep, sp=sp,
+                          pp_engine=pp_engine,
                           extra={"param_dtype": param_dtype,
                                  "optimizer_name": optimizer})
     model_cfg = build_model_config(cfg)
@@ -142,7 +143,7 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
         gen=args_ns.gen, param_dtype=args_ns.param_dtype,
         optimizer=args_ns.optimizer,
         dp=args_ns.dp, tp=args_ns.tp, cp=args_ns.cp, pp=args_ns.pp,
-        ep=args_ns.ep, sp=args_ns.sp)
+        ep=args_ns.ep, sp=args_ns.sp, pp_engine=args_ns.pp_engine)
     # XLA:TPU enforces the HBM budget at compile time (RESOURCE_EXHAUSTED
     # on overflow), so a successful compile IS the fit verdict — the
     # caller's except path records the failure. The size fields below are
@@ -158,6 +159,8 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
         "gen": args_ns.gen, "param_dtype": args_ns.param_dtype,
         **{ax: getattr(args_ns, ax) for ax in ("dp", "tp", "cp", "pp", "ep")
            if getattr(args_ns, ax) > 1},
+        **({"sp": True} if args_ns.sp else {}),
+        **({"pp_engine": args_ns.pp_engine} if args_ns.pp > 1 else {}),
         "argument_gb": round(arg / 1e9, 3),
         "temp_gb": round(m.temp_size_in_bytes / 1e9, 3),
         "output_gb": round(m.output_size_in_bytes / 1e9, 3),
@@ -182,6 +185,10 @@ def main() -> None:
     for ax in ("dp", "tp", "cp", "pp", "ep"):
         ap.add_argument(f"--{ax}", type=int, default=1)
     ap.add_argument("--sp", action="store_true", help="sequence parallel")
+    ap.add_argument("--pp-engine", default="afab", choices=["afab", "1f1b"],
+                    help="pipeline schedule to analyze (afab is the "
+                         "config/train.py default; 1f1b is the O(pp)-memory "
+                         "chunked schedule)")
     ap.add_argument("--policies", nargs="*", default=None,
                     help="remat policies to compare (implies --gc)")
     ap.add_argument("--sweep-gc", action="store_true",
