@@ -1,10 +1,19 @@
-"""The jitted training step: grad accumulation, clipping, update, metrics.
+"""The GSPMD training step: grad accumulation, clipping, update, metrics.
 
 Parity with reference scaletorch/trainer/train_step.py:14-136 (non-PP
 path): per-microbatch forward/backward under grad accumulation with a
 single gradient synchronisation (the ``no_sync`` contract,
 data_parallel.py:46-68), loss scaled by 1/accum, clip-by-global-norm, then
 the optimizer step.
+
+SCOPE vs parallel/spmd.py: this is the *declarative* step — plain jit
+with sharding-annotation-driven parallelism. It serves (a) the FSDP path
+(parallel/fsdp.py places params sharded and XLA inserts the
+gathers/reduce-scatters), (b) single-device training, and (c) the
+single-device golden half of the parallel test suite. The production
+tp/pp/cp/ep Trainer path is the explicit shard_map step in
+parallel/spmd.py — model-parallel collectives cannot be expressed as
+placement alone.
 
 TPU-native shape: the whole optimizer step is ONE jitted function; grad
 accumulation is a ``lax.scan`` over the leading microbatch axis, so
