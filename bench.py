@@ -65,6 +65,21 @@ SINGLE_CHIP_ROWS = {
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM")
 
 
+def _pallas_active() -> bool:
+    """Was the Pallas flash kernel actually the attention path for this
+    run? (Guards the fallback retry: non-kernel failures — config errors,
+    CPU runs, FLASH_ATTEN=0 — would just reproduce identically.)"""
+    from scaletorch_tpu.env import get_env
+    from scaletorch_tpu.ops.flash_attention import _pallas_available
+
+    if get_env("SCALETORCH_TPU_DISABLE_PALLAS") or not get_env("FLASH_ATTEN"):
+        return False
+    try:
+        return _pallas_available()
+    except Exception:  # noqa: BLE001 — backend not even initialisable
+        return False
+
+
 def run_row(label: str, warmup: int, steps: int) -> dict:
     from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
 
@@ -73,25 +88,39 @@ def run_row(label: str, warmup: int, steps: int) -> dict:
     shape.setdefault("remat_policy", os.environ.get(
         "BENCH_REMAT_POLICY", "nothing_saveable"))
     gc_fallback = False
+    pallas_fallback = False
+    first_error = None
     try:
         cfg = make_bench_args(model, **shape)
         r = benchmark_config(cfg, warmup=warmup, steps=steps)
     except Exception as e:  # noqa: BLE001
-        # The reference measured its no-GC rows on 64 GB 910Bs; on a
-        # smaller-HBM chip rerun them with gradient checkpointing and say
-        # so, rather than reporting nothing.
-        if shape.get("gc") or not any(m in repr(e) for m in _OOM_MARKERS):
+        is_oom = any(m in repr(e) for m in _OOM_MARKERS)
+        if is_oom and not shape.get("gc"):
+            # The reference measured its no-GC rows on 64 GB 910Bs; on a
+            # smaller-HBM chip rerun them with gradient checkpointing and
+            # say so, rather than reporting nothing.
+            gc_fallback = True
+        elif not is_oom and _pallas_active():
+            # Kernel-runtime regression on this chip/toolchain should
+            # degrade the row to the XLA SDPA path, not erase it.
+            pallas_fallback = True
+        else:
             raise
-        gc_fallback = True
-    if gc_fallback:
+        first_error = repr(e)[:300]
+        print(json.dumps({"event": "row_fallback", "metric": label,
+                          "error": first_error}), file=sys.stderr, flush=True)
+    if gc_fallback or pallas_fallback:
         # Retry outside the except block: the exception's traceback pins
-        # the OOM'd attempt's device buffers until it is cleared.
+        # the failed attempt's device buffers until it is cleared.
         import gc
 
         gc.collect()
-        cfg = make_bench_args(model, **dict(shape, gc=True))
+        if pallas_fallback:
+            os.environ["SCALETORCH_TPU_DISABLE_PALLAS"] = "1"
+        cfg = make_bench_args(model, **dict(shape, gc=True)
+                              if gc_fallback else shape)
         r = benchmark_config(cfg, warmup=warmup, steps=steps)
-        # peak_bytes_in_use still reflects the OOM'd first attempt (no
+        # peak_bytes_in_use still reflects the failed first attempt (no
         # reset API), so the fallback row's memory reading is meaningless.
         r["memory_gb"] = None
     import jax
@@ -114,6 +143,8 @@ def run_row(label: str, warmup: int, steps: int) -> dict:
         "memory_gb": r["memory_gb"],
         "device": jax.local_devices()[0].device_kind,
         **({"gc_fallback": True} if gc_fallback else {}),
+        **({"pallas_fallback": True} if pallas_fallback else {}),
+        **({"fallback_error": first_error} if first_error else {}),
         # Echo every training-recipe deviation so cross-commit bench JSON
         # diffs show WHAT changed, not just that the number moved.
         **{k: v for k, v in shape.get("extra", {}).items()
