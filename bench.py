@@ -94,19 +94,24 @@ def run_row(label: str, warmup: int, steps: int) -> dict:
         cfg = make_bench_args(model, **shape)
         r = benchmark_config(cfg, warmup=warmup, steps=steps)
     except Exception as e:  # noqa: BLE001
-        is_oom = any(m in repr(e) for m in _OOM_MARKERS)
-        if is_oom and not shape.get("gc"):
+        err = repr(e)
+        # VMEM RESOURCE_EXHAUSTED is a kernel-tile overflow (a Pallas
+        # problem), NOT an HBM capacity problem — classify it as a kernel
+        # failure so the pallas fallback, not the gc fallback, engages.
+        is_hbm_oom = (any(m in err for m in _OOM_MARKERS)
+                      and "vmem" not in err.lower())
+        if is_hbm_oom and not shape.get("gc"):
             # The reference measured its no-GC rows on 64 GB 910Bs; on a
             # smaller-HBM chip rerun them with gradient checkpointing and
             # say so, rather than reporting nothing.
             gc_fallback = True
-        elif not is_oom and _pallas_active():
+        elif not is_hbm_oom and _pallas_active():
             # Kernel-runtime regression on this chip/toolchain should
             # degrade the row to the XLA SDPA path, not erase it.
             pallas_fallback = True
         else:
             raise
-        first_error = repr(e)[:300]
+        first_error = err[:300]
         print(json.dumps({"event": "row_fallback", "metric": label,
                           "error": first_error}), file=sys.stderr, flush=True)
     if gc_fallback or pallas_fallback:
@@ -117,8 +122,12 @@ def run_row(label: str, warmup: int, steps: int) -> dict:
         gc.collect()
         if pallas_fallback:
             os.environ["SCALETORCH_TPU_DISABLE_PALLAS"] = "1"
-        cfg = make_bench_args(model, **dict(shape, gc=True)
-                              if gc_fallback else shape)
+            if not shape.get("gc"):
+                # the SDPA fallback materialises full score matrices; a
+                # no-GC shape would trade a kernel failure for an HBM OOM
+                gc_fallback = True
+        cfg = make_bench_args(model, **(dict(shape, gc=True)
+                                        if gc_fallback else shape))
         r = benchmark_config(cfg, warmup=warmup, steps=steps)
         # peak_bytes_in_use still reflects the failed first attempt (no
         # reset API), so the fallback row's memory reading is meaningless.

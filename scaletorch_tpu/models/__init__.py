@@ -15,6 +15,7 @@ from scaletorch_tpu.models.qwen3 import Qwen3, Qwen3Config  # noqa: F401
 from scaletorch_tpu.models.qwen3_moe import Qwen3MoE, Qwen3MoEConfig  # noqa: F401
 from scaletorch_tpu.models.gpt_moe import GPTMoE, GPTMoEConfig  # noqa: F401
 from scaletorch_tpu.models.lenet import LeNet, LeNetConfig  # noqa: F401
+from scaletorch_tpu.models.resnet import ResNetConfig  # noqa: F401
 
 # Register the non-default attention backends (flash; ring arrives with the
 # context-parallel module).

@@ -13,3 +13,9 @@ from scaletorch_tpu.parallel.pipeline_parallel import (  # noqa: F401
     stage_layer_partition,
     validate_pp_divisibility,
 )
+from scaletorch_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_param_specs,
+    make_fsdp_train_step,
+    setup_fsdp,
+    shard_params_fsdp,
+)

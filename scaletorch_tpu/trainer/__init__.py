@@ -6,3 +6,4 @@ from scaletorch_tpu.trainer.lr_scheduler import (  # noqa: F401
 )
 from scaletorch_tpu.trainer.optimizer import create_optimizer  # noqa: F401
 from scaletorch_tpu.trainer.train_step import make_train_step  # noqa: F401
+from scaletorch_tpu.trainer.factored import adafactor_sharded  # noqa: F401

@@ -13,3 +13,7 @@ from scaletorch_tpu.utils.misc import (  # noqa: F401
     set_all_seed,
     to_readable_format,
 )
+from scaletorch_tpu.utils.env_info import (  # noqa: F401
+    get_system_info,
+    log_system_info,
+)
