@@ -17,11 +17,15 @@ import time
 from typing import Any, Dict, Optional
 
 
-def benchmark_config(cfg, warmup: int = 3, steps: int = 10) -> Dict[str, Any]:
+def benchmark_config(
+    cfg, warmup: int = 3, steps: int = 10, progress=None
+) -> Dict[str, Any]:
     """Run one timed benchmark for a ScaleTorchTPUArguments config.
 
     Returns {tokens_per_second, tokens_per_second_per_chip, mfu, loss,
-    step_time_s, memory_gb, num_params, num_chips}.
+    step_time_s, memory_gb, num_params, num_chips}. ``progress`` is an
+    optional callback taking a stage name ("trainer_built", "compiled",
+    "timed") — bench.py's hang classifier.
     """
     import jax
 
@@ -29,7 +33,9 @@ def benchmark_config(cfg, warmup: int = 3, steps: int = 10) -> Dict[str, Any]:
     from scaletorch_tpu.utils.device import device_memory_stats
     from scaletorch_tpu.utils.misc import get_mfu, get_num_params
 
+    progress = progress or (lambda stage: None)
     trainer = Trainer(cfg)
+    progress("trainer_built")
     try:
         # Drive step_fn directly (not trainer.train) so timing excludes the
         # metrics/logging machinery and the final loss is always captured.
@@ -41,6 +47,7 @@ def benchmark_config(cfg, warmup: int = 3, steps: int = 10) -> Dict[str, Any]:
                 trainer.params, trainer.opt_state, batch
             )
         jax.block_until_ready(trainer.params)
+        progress("compiled")
 
         t0 = time.perf_counter()
         for _ in range(steps):
@@ -55,6 +62,7 @@ def benchmark_config(cfg, warmup: int = 3, steps: int = 10) -> Dict[str, Any]:
         final_loss = float(m["loss"])
         jax.block_until_ready(trainer.params)
         elapsed = time.perf_counter() - t0
+        progress("timed")
 
         tok_s = trainer.loader.tokens_per_step * steps / elapsed
         num_chips = len(jax.devices())

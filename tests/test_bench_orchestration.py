@@ -1,0 +1,198 @@
+"""bench.py hang-proof orchestration (parent side, no device work).
+
+Round 2's benchmark produced nothing because the in-process run had no
+wall-clock protection: a wedged backend init / kernel raises no
+exception. These tests drive the parent orchestration against fake
+children (CHILD_ARGV monkeypatched) covering every child outcome —
+success, error, SIGINT-responsive hang, SIGINT-ignoring wedge — and
+assert the driver contract: exactly one JSON line on stdout, always.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+FAKE_CHILD = textwrap.dedent(
+    """
+    import json, os, signal, sys, time
+
+    spec = json.loads(os.environ["FAKE_SPEC"])
+    if os.environ.get("BENCH_PREFLIGHT") == "1":
+        mode = "preflight"
+    elif os.environ.get("SCALETORCH_TPU_DISABLE_PALLAS") == "1":
+        mode = "sdpa_row"
+    else:
+        mode = "pallas_row"
+    beh = spec[mode]
+
+    def mark(stage):
+        print(json.dumps({"event": "progress", "stage": stage}),
+              file=sys.stderr, flush=True)
+
+    if beh == "hang_at_init":          # dead tunnel: no marker ever
+        time.sleep(600)
+    mark("backend_up")
+    if beh == "hang":                  # SIGINT-responsive mid-run hang
+        time.sleep(600)
+    if beh == "wedge":                 # ignores SIGINT (stuck in C++)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        time.sleep(600)
+    if beh == "error":
+        print(json.dumps({"metric": mode, "error": "boom"}))
+        sys.exit(1)
+    mark("done")
+    if mode == "preflight":
+        print(json.dumps({"preflight": "ok", "step_ms": 1.0}))
+    else:
+        mfu = spec[mode + "_mfu"]
+        print(json.dumps({
+            "metric": "qwen3-0.6b_seq8192_bs1_gc_single_chip_mfu",
+            "value": mfu, "unit": "% MFU", "vs_baseline": round(mfu / 39.0, 3),
+            "tokens_per_second": 9000.0,
+            "attention_path": "sdpa" if mode == "sdpa_row" else "pallas",
+        }), flush=True)
+    if beh == "ok_then_hang":           # result printed, teardown stalls
+        time.sleep(600)
+    """
+)
+
+
+@pytest.fixture()
+def fake_bench(tmp_path, monkeypatch):
+    """Point bench at a scriptable fake child; run in a tmp cwd."""
+    child = tmp_path / "fake_child.py"
+    child.write_text(FAKE_CHILD)
+    monkeypatch.setattr(bench, "CHILD_ARGV", [sys.executable, str(child)])
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setenv("BENCH_SIGINT_WAITS", "1,1")
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "900")
+    monkeypatch.setenv("BENCH_ROW_BUDGET", "10")
+    monkeypatch.setenv("BENCH_PREFLIGHT_BUDGET", "5")
+    monkeypatch.setenv("BENCH_PALLAS_ROW_BUDGET", "5")
+
+    def set_spec(**spec):
+        monkeypatch.setenv("FAKE_SPEC", json.dumps(spec))
+
+    return set_spec
+
+
+def _stdout_line(capsys):
+    out = [ln for ln in capsys.readouterr().out.splitlines() if ln.strip()]
+    assert len(out) == 1, f"driver contract: exactly one stdout line, got {out}"
+    return json.loads(out[0])
+
+
+def test_pallas_wins_when_faster(fake_bench, capsys):
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=52.0)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 52.0
+    assert line["attention_path"] == "pallas"
+    assert line["sdpa_mfu"] == 45.4
+    table = json.loads(open("bench_table.json").read())
+    assert set(table) == {bench.HEADLINE + "_sdpa", bench.HEADLINE + "_pallas"}
+
+
+def test_sdpa_kept_when_pallas_slower(fake_bench, capsys):
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=40.0)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 45.4
+    assert line["attention_path"] == "sdpa"
+    assert line["pallas_mfu"] == 40.0
+
+
+def test_preflight_wedge_still_reports_banked_row(fake_bench, capsys):
+    """The round-2 failure shape: the Pallas path wedges ignoring SIGINT.
+    The banked SDPA number must still be the stdout line."""
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4, preflight="wedge")
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 45.4
+    assert "budget" in line["pallas_skipped"]
+
+
+def test_pallas_row_hang_still_reports_banked_row(fake_bench, capsys):
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="hang")
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 45.4
+    assert "pallas_skipped" in line
+
+
+def test_result_kept_when_child_stalls_in_teardown(fake_bench, capsys):
+    """A child that printed its measurement but stalled in PJRT-client
+    teardown still counts: the number is real, only the exit was late."""
+    fake_bench(sdpa_row="ok_then_hang", sdpa_row_mfu=45.4, preflight="wedge")
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 45.4
+    assert line["late_exit"] is True
+
+
+def test_dead_tunnel_fails_fast_with_classified_error(fake_bench, capsys,
+                                                      monkeypatch):
+    monkeypatch.setenv("BENCH_ROW_BUDGET", "2")
+    fake_bench(sdpa_row="hang_at_init")
+    assert bench.run_headline() == 1
+    line = _stdout_line(capsys)
+    assert line["metric"] == "error"
+    assert line["vs_baseline"] == 0
+    assert "tunnel" in line  # init-hang classified as dead tunnel
+
+
+def test_child_error_propagates(fake_bench, capsys, monkeypatch):
+    fake_bench(sdpa_row="error")
+    assert bench.run_headline() == 1
+    line = _stdout_line(capsys)
+    assert line["metric"] == "error"
+    assert "boom" in line["error"]
+
+
+def test_mid_run_hang_budgets_and_classifies_stage(fake_bench, monkeypatch):
+    monkeypatch.setenv("FAKE_SPEC", json.dumps({"sdpa_row": "hang"}))
+    res = bench._run_child({"BENCH_ROW": bench.HEADLINE,
+                            "SCALETORCH_TPU_DISABLE_PALLAS": "1"}, 2, "sdpa_row")
+    assert res.timed_out and not res.wedged  # SIGINT worked
+    assert res.stage == "backend_up"
+    assert "backend_up" in res.error
+
+
+def test_table_mode_short_circuits_after_wedge(fake_bench, capsys, monkeypatch):
+    """A wedged row must not burn every later row's budget: the chip is
+    held, so remaining rows are recorded as skipped."""
+    monkeypatch.setenv("BENCH_TABLE_ROW_BUDGET", "2")
+    # every row uses the non-disable path in table mode -> pallas_row
+    fake_bench(pallas_row="wedge")
+    assert bench.run_table() == 1
+    table = json.loads(open("bench_table.json").read())
+    assert len(table) == len(bench.SINGLE_CHIP_ROWS)
+    statuses = [v.get("error", "") for v in table.values()]
+    assert any("budget" in s for s in statuses[:1])
+    assert all("skipped: chip wedged" in s for s in statuses[1:])
+    line = _stdout_line(capsys)
+    assert line["metric"] == "error"
+
+
+def test_last_stage_parser():
+    err = "\n".join([
+        "noise",
+        json.dumps({"event": "progress", "stage": "backend_up"}),
+        "WARNING: something",
+        json.dumps({"event": "progress", "stage": "compiled"}),
+    ])
+    assert bench._last_stage(err) == "compiled"
+    assert bench._last_stage("no markers here") is None
