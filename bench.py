@@ -173,6 +173,12 @@ def _run_child(env_overrides: dict, budget_s: int, label: str) -> ChildResult:
     """
     t0 = time.perf_counter()
     env = dict(os.environ)
+    # Pin the child-mode selectors: a stale exported BENCH_PREFLIGHT /
+    # BENCH_ROW from a manual debugging run must not hijack the child's
+    # dispatch (a preflight payload recorded as the banked measurement
+    # would break the driver contract downstream).
+    env["BENCH_PREFLIGHT"] = "0"
+    env["BENCH_ROW"] = ""
     env.update({k: str(v) for k, v in env_overrides.items()})
     with tempfile.TemporaryFile(mode="w+") as out, \
             tempfile.TemporaryFile(mode="w+") as err:
@@ -402,7 +408,8 @@ def run_headline() -> int:
     # configuration: 45.41% MFU / 1.164x baseline).
     banked = _run_child(
         {"BENCH_ROW": HEADLINE, "SCALETORCH_TPU_DISABLE_PALLAS": "1"},
-        _budget("BENCH_ROW_BUDGET", 600), "sdpa_row")
+        min(_budget("BENCH_ROW_BUDGET", 600),
+            int(deadline - time.perf_counter())), "sdpa_row")
     if banked.ok:
         results["sdpa"] = banked.payload
         _dump_table({HEADLINE + "_sdpa": banked.payload})
@@ -419,7 +426,12 @@ def run_headline() -> int:
     # Phase 2 — Pallas experiment, only with a healthy chip and budget.
     remaining = deadline - time.perf_counter()
     skip_reason = None
-    if os.environ.get("BENCH_SKIP_PALLAS_EXPERIMENT") == "1":
+    if banked.wedged:
+        # the banked child printed its result but never exited (stuck in
+        # teardown ignoring SIGINT) — the chip is held; launching more
+        # device children would just burn their budgets against it
+        skip_reason = "chip held by the wedged sdpa child"
+    elif os.environ.get("BENCH_SKIP_PALLAS_EXPERIMENT") == "1":
         skip_reason = "BENCH_SKIP_PALLAS_EXPERIMENT=1"
     elif remaining < 360:
         skip_reason = f"only {int(remaining)}s budget left"
@@ -507,7 +519,12 @@ def main() -> int:
         raise SystemExit(f"unknown arguments {unknown}; supported: --table "
                          "(other knobs via BENCH_* env vars)")
 
-    # Child modes first: they are the only paths that import JAX.
+    # An explicit --table wins over any (possibly stale) child-mode env:
+    # table children are spawned WITHOUT --table, so there is no recursion.
+    if "--table" in sys.argv:
+        return run_table()
+
+    # Child modes next: they are the only paths that import JAX.
     if os.environ.get("BENCH_PREFLIGHT") == "1" or os.environ.get("BENCH_ROW"):
         # stdout must carry ONLY the result JSON (parent parses the last
         # line): move the framework logger's streams to stderr.
@@ -535,8 +552,6 @@ def main() -> int:
         print(json.dumps(run_row(label, warmup, steps)))
         return 0
 
-    if "--table" in sys.argv:
-        return run_table()
     return run_headline()
 
 

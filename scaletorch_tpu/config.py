@@ -100,6 +100,13 @@ class ParallelArguments:
     tensor_parallel_size: int = field(default=1, metadata={"help": "TP degree."})
     pipeline_parallel_size: int = field(default=1, metadata={"help": "PP degree."})
     context_parallel_size: int = field(default=1, metadata={"help": "CP degree."})
+    cp_layout: str = field(
+        default="zigzag",
+        metadata={"help": "contiguous | zigzag — CP sequence-shard layout. "
+                          "zigzag stripes the sequence so every ring rank "
+                          "does equal causal work (parallel/zigzag.py); "
+                          "contiguous matches the reference's skewed ring."},
+    )
     expert_parallel_size: int = field(default=1, metadata={"help": "EP degree."})
     # Default differs from the reference (pipeline_parallel_engine='1f1b',
     # config.py:155-173) BY MEASUREMENT: in the SPMD design afab already
@@ -135,6 +142,10 @@ class ParallelArguments:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
         if self.pp_engine not in ("1f1b", "afab"):
             raise ValueError(f"pp_engine must be '1f1b' or 'afab', got {self.pp_engine!r}")
+        if self.cp_layout not in ("contiguous", "zigzag"):
+            raise ValueError(
+                f"cp_layout must be 'contiguous' or 'zigzag', got {self.cp_layout!r}"
+            )
         if self.sequence_parallel and self.tensor_parallel_size == 1:
             raise ValueError("sequence_parallel requires tensor_parallel_size > 1")
 
@@ -296,6 +307,14 @@ class ScaleTorchTPUArguments(
             raise ValueError(
                 f"sequence_length {self.sequence_length} not divisible by "
                 f"context_parallel_size {self.context_parallel_size}"
+            )
+        if (self.context_parallel_size > 1 and self.cp_layout == "zigzag"
+                and self.sequence_length % (2 * self.context_parallel_size)):
+            raise ValueError(
+                f"cp_layout='zigzag' needs sequence_length "
+                f"{self.sequence_length} divisible by 2*cp "
+                f"({2 * self.context_parallel_size}); use cp_layout="
+                f"'contiguous' for odd stripe splits"
             )
         if self.sequence_parallel:
             seq_local = self.sequence_length // self.context_parallel_size

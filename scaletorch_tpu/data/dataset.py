@@ -63,15 +63,19 @@ class DatasetProcessor:
 
     def __init__(
         self,
-        tokenizer_name_or_path: str,
+        tokenizer_name_or_path,
         sequence_length: int,
         tokenize_strategy: str = "concat_chunk",
         text_key: str = "text",
         num_proc: int = 4,
     ) -> None:
-        from transformers import AutoTokenizer
+        if isinstance(tokenizer_name_or_path, str):
+            from transformers import AutoTokenizer
 
-        self.tokenizer = AutoTokenizer.from_pretrained(tokenizer_name_or_path)
+            self.tokenizer = AutoTokenizer.from_pretrained(tokenizer_name_or_path)
+        else:
+            # an already-constructed tokenizer object (offline / custom)
+            self.tokenizer = tokenizer_name_or_path
         self.sequence_length = sequence_length
         self.strategy = get_tokenize_strategy(tokenize_strategy)
         self.text_key = text_key

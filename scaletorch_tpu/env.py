@@ -59,6 +59,10 @@ register_env("SCALETORCH_TPU_DISABLE_PALLAS", "0", _as_bool)  # force XLA fallba
 # AOT compile-only sessions (tools/aot_memory.py) have no local devices at
 # all, and remote-execution PJRT plugins may report a tunnel platform name.
 register_env("SCALETORCH_TPU_FORCE_PALLAS", "0", _as_bool)
+# Context-parallel sequence layout: 'contiguous' or 'zigzag' (balanced
+# causal work per ring rank; needs the loader's zigzag token order —
+# parallel/zigzag.py). Read by the 'ring' backend at trace time.
+register_env("SCALETORCH_TPU_CP_LAYOUT", "contiguous", str)
 # Sequence-chunk length for the fused LM-head + cross-entropy (bounds the
 # live fp32 [B, C, V/tp] logits transient; halve on HBM-edge configs).
 register_env("SCALETORCH_TPU_CE_CHUNK", "1024", int)

@@ -383,24 +383,50 @@ def decoder_stack(
     sequence_parallel: bool = False,
     gradient_checkpointing: bool = False,
     remat_policy: str = "nothing_saveable",
+    active_layers: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Scan ``_decoder_layer`` over a stack of layer params (leading axis =
     layer index). Used by ``forward`` for the whole model and by pipeline
-    parallelism for one stage's layer subset."""
+    parallelism for one stage's layer subset.
 
-    def layer_body(h, layer_params):
-        h = _decoder_layer(
+    ``active_layers`` (scalar) marks the first k stacked slots as real;
+    later slots are identity padding (uneven pipeline stages — reference
+    PipelineParallel supports ragged layer counts, pipeline_parallel.py:
+    83-133 — pad the stacked axis and mask here). Masked slots forward
+    ``h`` unchanged, so their (zero-initialised) params get exactly zero
+    gradient through the ``where``.
+    """
+
+    def layer_body(h, xs):
+        layer_params, idx = xs
+        out = _decoder_layer(
             h, layer_params, cos, sin, cfg, attn_fn,
             tp_axis=tp_axis, sequence_parallel=sequence_parallel,
         )
-        return h, None
+        if active_layers is not None:
+            out = jnp.where(idx < active_layers, out, h)
+        return out, None
 
     if gradient_checkpointing:
         layer_body = jax.checkpoint(
             layer_body, policy=resolve_remat_policy(remat_policy)
         )
-    x, _ = jax.lax.scan(layer_body, x, layers)
+    x, _ = jax.lax.scan(
+        layer_body, x, (layers, scan_slot_indices(layers, active_layers))
+    )
     return x
+
+
+def scan_slot_indices(layers: Params, active_layers) -> jax.Array:
+    """Per-slot indices [0..n_slots) for a stacked-layer scan. When an
+    ``active_layers`` mask scalar is in play, the indices are broadcast
+    onto its varying-mesh-axes (the ``+ 0 *`` trick) so the in-scan
+    ``jnp.where`` compares vma-consistent operands under shard_map."""
+    n_slots = jax.tree_util.tree_leaves(layers)[0].shape[0]
+    idx = jnp.arange(n_slots, dtype=jnp.int32)
+    if active_layers is not None:
+        idx = idx + 0 * active_layers.astype(jnp.int32)
+    return idx
 
 
 def forward(

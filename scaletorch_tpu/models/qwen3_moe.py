@@ -212,35 +212,53 @@ def moe_decoder_stack(
     sequence_parallel: bool = False,
     gradient_checkpointing: bool = False,
     remat_policy: str = "nothing_saveable",
+    active_layers: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, dict]:
     """Scan attention+MoE layers over a stacked layer block; returns
     (hidden, aux_loss_sum, stats_layer_mean). The MoE counterpart of
     llama.decoder_stack, shared by the full forward and by one pipeline
-    stage's compute (where ``layers`` is the pp-sharded [L/pp, ...] block)."""
+    stage's compute (where ``layers`` is the pp-sharded [L/pp, ...] block).
+    ``active_layers`` masks identity padding slots exactly like
+    llama.decoder_stack (uneven pipeline stages): padded slots forward
+    ``h`` and contribute zero aux/stats."""
     extra = tuple(a for a in (tp_axis, ep_axis) if a)
     x = pvary_missing(x, extra) if extra else x
 
-    def layer_body(h, layer_params):
-        h = _llama.attention_block(h, layer_params, cos, sin, cfg, attn_fn,
-                                   helpers)
-        h, aux, stats = moe_block(
-            h, layer_params, cfg, helpers,
+    def layer_body(h, xs):
+        layer_params, idx = xs
+        out = _llama.attention_block(h, layer_params, cos, sin, cfg, attn_fn,
+                                     helpers)
+        out, aux, stats = moe_block(
+            out, layer_params, cfg, helpers,
             ep_axis=ep_axis, tp_axis=tp_axis,
             sequence_parallel=sequence_parallel,
         )
+        if active_layers is not None:
+            live = idx < active_layers
+            out = jnp.where(live, out, h)
+            aux = jnp.where(live, aux, 0.0)
+            stats = jax.tree.map(lambda v: jnp.where(live, v, 0.0), stats)
         if extra:
-            h, aux = pvary_missing(h, extra), pvary_missing(aux, extra)
+            out, aux = pvary_missing(out, extra), pvary_missing(aux, extra)
             stats = jax.tree.map(lambda v: pvary_missing(v, extra), stats)
-        return h, (aux, stats)
+        return out, (aux, stats)
 
     if gradient_checkpointing:
         layer_body = jax.checkpoint(
             layer_body, policy=_llama.resolve_remat_policy(remat_policy)
         )
 
-    x, (aux_per_layer, stats_per_layer) = jax.lax.scan(layer_body, x, layers)
+    x, (aux_per_layer, stats_per_layer) = jax.lax.scan(
+        layer_body, x,
+        (layers, _llama.scan_slot_indices(layers, active_layers)))
     aux_loss = jnp.sum(aux_per_layer)
-    moe_stats = jax.tree.map(lambda v: jnp.mean(v, axis=0), stats_per_layer)
+    if active_layers is None:
+        moe_stats = jax.tree.map(lambda v: jnp.mean(v, axis=0), stats_per_layer)
+    else:
+        # mean over REAL layers only — padded slots contributed zeros
+        denom = jnp.maximum(active_layers.astype(jnp.float32), 1.0)
+        moe_stats = jax.tree.map(
+            lambda v: jnp.sum(v, axis=0) / denom, stats_per_layer)
     return x, aux_loss, moe_stats
 
 

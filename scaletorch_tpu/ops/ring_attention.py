@@ -33,6 +33,22 @@ ships contiguous shards; positions arrive via the sharded position_ids).
 GQA: K/V circulate **unexpanded** (fewer bytes on the ring); the pallas
 kernel reads them unexpanded via index maps, the xla path expands per
 block.
+
+Two sequence layouts:
+
+  * ``layout='contiguous'`` — rank r holds tokens [r·S/cp, (r+1)·S/cp).
+    The causal skip halves total FLOPs but leaves the ring
+    load-imbalanced: rank r computes r+1 blocks while all ranks tick in
+    lockstep, so wall-clock is rank cp-1's (the reference has the same
+    skew, context_parallel.py:154-171).
+  * ``layout='zigzag'`` — the sequence is split into 2·cp stripes and
+    rank r holds stripes r and 2cp-1-r concatenated (the
+    zhuzilin/ring-flash-attention zigzag scheme). Every ring step then
+    costs exactly two stripe-pair attention blocks on EVERY rank —
+    perfectly balanced causal work, no idle ranks. The host permutes
+    the token order (parallel/zigzag.py) so the mesh's contiguous cp
+    slices are exactly these stripe pairs; absolute position_ids ride
+    along, so RoPE and the loss are layout-transparent.
 """
 
 from __future__ import annotations
@@ -119,6 +135,64 @@ def _ring_forward(q, k, v, axis: str, scale: float, impl: str, interpret: bool):
     return o.astype(q.dtype), lse
 
 
+def _ring_forward_zigzag(q, k, v, axis: str, scale: float, impl: str,
+                         interpret: bool):
+    """Load-balanced forward: local shards are [low stripe; high stripe].
+
+    With low_r = r and high_r = 2cp-1-r, the causal structure against the
+    block from origin j is total (two stripe-pairs of work) at EVERY step:
+
+      j == r: low×low causal + high×high causal + high×low full
+      j <  r: both query stripes attend j's LOW stripe fully (high_j is
+              above even our high stripe);
+      j >  r: only our HIGH stripe attends, but to BOTH of j's stripes.
+    """
+    cp = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    n_rep = q.shape[1] // k.shape[1]
+    perm = _ring_perm(axis)
+    blk = partial(_fwd_block, scale=scale, impl=impl, interpret=interpret,
+                  n_rep=n_rep)
+    sh = q.shape[2] // 2
+    ql, qh = q[:, :, :sh], q[:, :, sh:]
+
+    # diagonal step
+    kl, kh = k[:, :, :sh], k[:, :, sh:]
+    vl, vh = v[:, :, :sh], v[:, :, sh:]
+    o_l, lse_l = blk(ql, kl, vl, causal_diag=True)
+    o_hh, lse_hh = blk(qh, kh, vh, causal_diag=True)
+    o_hl, lse_hl = blk(qh, kl, vl, causal_diag=False)
+    o_h, lse_h = _merge(o_hh, lse_hh, o_hl, lse_hl)
+    o = jnp.concatenate([o_l, o_h], axis=2)
+    lse = jnp.concatenate([lse_l, lse_h], axis=2)
+
+    k_blk, v_blk = k, v
+    for t in range(1, cp):
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        j = (r - t) % cp  # origin rank of the block now held
+
+        def older(o=o, lse=lse, k_blk=k_blk, v_blk=v_blk):
+            # j < r: full attention of [ql; qh] onto j's low stripe
+            o2, lse2 = blk(q, k_blk[:, :, :sh], v_blk[:, :, :sh],
+                           causal_diag=False)
+            return _merge(o, lse, o2, lse2)
+
+        def newer(o=o, lse=lse, k_blk=k_blk, v_blk=v_blk):
+            # j > r: our high stripe attends both of j's stripes; the low
+            # query stripe gets a -inf lse pad (a no-op in the merge)
+            o2h, lse2h = blk(qh, k_blk, v_blk, causal_diag=False)
+            o2 = jnp.concatenate([jnp.zeros_like(o2h), o2h], axis=2)
+            lse2 = jnp.concatenate(
+                [jnp.full_like(lse2h, -jnp.inf), lse2h], axis=2)
+            return _merge(o, lse, o2, lse2)
+
+        # equal-cost branches: half the ranks take each at every step
+        o, lse = jax.lax.cond(j < r, older, newer)
+
+    return o.astype(q.dtype), lse
+
+
 def _bwd_block_xla(q, k, v, dout, lse, delta, scale, causal_diag: bool):
     """Gradients of one pre-expanded block: (dq, dk, dv) in fp32.
 
@@ -166,10 +240,92 @@ def _bwd_block(q, k_blk, v_blk, out, lse, dout, delta, *,
     return dq, _sum_heads(dk, n_rep), _sum_heads(dv, n_rep)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_backward_zigzag(q, k, v, out, lse, dout, axis, scale, impl,
+                          interpret):
+    """Backward mirror of the zigzag schedule: the dk/dv accumulator
+    circulates with the K/V block in the ORIGIN rank's [low; high] stripe
+    layout, receiving each step's contribution into the stripes that
+    step actually attended."""
+    cp = jax.lax.axis_size(axis)
+    r = jax.lax.axis_index(axis)
+    n_rep = q.shape[1] // k.shape[1]
+    perm = _ring_perm(axis)
+    blk = partial(_bwd_block, scale=scale, impl=impl, interpret=interpret,
+                  n_rep=n_rep)
+    sh = q.shape[2] // 2
+
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    ql, qh = q[:, :, :sh], q[:, :, sh:]
+    kl, kh = k[:, :, :sh], k[:, :, sh:]
+    vl, vh = v[:, :, :sh], v[:, :, sh:]
+    out_l, out_h = out[:, :, :sh], out[:, :, sh:]
+    do_l, do_h = dout[:, :, :sh], dout[:, :, sh:]
+    lse_l, lse_h = lse[:, :, :sh], lse[:, :, sh:]
+    dta_l, dta_h = delta[:, :, :sh], delta[:, :, sh:]
+
+    # diagonal step: the same three blocks as the forward
+    dql, dkl, dvl = blk(ql, kl, vl, out_l, lse_l, do_l, dta_l,
+                        causal_diag=True)
+    dqh, dkh, dvh = blk(qh, kh, vh, out_h, lse_h, do_h, dta_h,
+                        causal_diag=True)
+    dqh2, dkl2, dvl2 = blk(qh, kl, vl, out_h, lse_h, do_h, dta_h,
+                           causal_diag=False)
+    dq = jnp.concatenate([dql, dqh + dqh2], axis=2)
+    dk_acc = jnp.concatenate([dkl + dkl2, dkh], axis=2)
+    dv_acc = jnp.concatenate([dvl + dvl2, dvh], axis=2)
+
+    k_blk, v_blk = k, v
+    for t in range(1, cp):
+        k_blk = jax.lax.ppermute(k_blk, axis, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis, perm)
+        dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+        dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+        j = (r - t) % cp
+
+        def older(dq=dq, dk_acc=dk_acc, dv_acc=dv_acc,
+                  k_blk=k_blk, v_blk=v_blk):
+            # j < r: the forward attended [ql; qh] x j's low stripe
+            dq_c, dk_c, dv_c = blk(
+                q, k_blk[:, :, :sh], v_blk[:, :, :sh],
+                out, lse, dout, delta, causal_diag=False)
+            zeros_k = jnp.zeros_like(dk_c)
+            return (dq + dq_c,
+                    dk_acc + jnp.concatenate([dk_c, zeros_k], axis=2),
+                    dv_acc + jnp.concatenate([dv_c, zeros_k], axis=2))
+
+        def newer(dq=dq, dk_acc=dk_acc, dv_acc=dv_acc,
+                  k_blk=k_blk, v_blk=v_blk):
+            # j > r: the forward attended qh x both of j's stripes
+            dq_c, dk_c, dv_c = blk(
+                qh, k_blk, v_blk, out_h, lse_h, do_h, dta_h,
+                causal_diag=False)
+            return (dq + jnp.concatenate([jnp.zeros_like(dq_c), dq_c], axis=2),
+                    dk_acc + dk_c, dv_acc + dv_c)
+
+        dq, dk_acc, dv_acc = jax.lax.cond(j < r, older, newer)
+
+    # one final rotation brings every accumulator home
+    dk_acc = jax.lax.ppermute(dk_acc, axis, perm)
+    dv_acc = jax.lax.ppermute(dv_acc, axis, perm)
+
+    return dq.astype(q.dtype), dk_acc.astype(k.dtype), dv_acc.astype(v.dtype)
+
+
+def _check_layout(layout: str, causal: bool, seq_local: int) -> None:
+    if not causal:
+        raise NotImplementedError("ring attention is causal-only")
+    if layout not in ("contiguous", "zigzag"):
+        raise ValueError(f"unknown cp layout {layout!r}")
+    if layout == "zigzag" and seq_local % 2:
+        raise ValueError(
+            f"zigzag layout needs an even local sequence, got {seq_local}"
+        )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_attention(q, k, v, axis: str = "cp", causal: bool = True,
                    scale: Optional[float] = None, impl: str = "xla",
-                   interpret: bool = False):
+                   interpret: bool = False, layout: str = "contiguous"):
     """Ring attention over mesh axis ``axis``; call inside shard_map.
 
     q: [B, Hq, S/cp, D]; k/v: [B, Hkv, S/cp, D] (local shards).
@@ -178,31 +334,37 @@ def ring_attention(q, k, v, axis: str = "cp", causal: bool = True,
 
     ``impl='pallas'`` computes each ring block with the flash kernel so
     per-step memory is O(S/cp · D), not O((S/cp)^2); ``impl='xla'`` is
-    the plain-softmax fallback (CPU tests).
+    the plain-softmax fallback (CPU tests). ``layout`` selects the
+    sequence-shard scheme (module docstring): 'zigzag' balances causal
+    work across ranks and needs the host-side zigzag token order
+    (parallel/zigzag.py).
     """
-    if not causal:
-        raise NotImplementedError("ring attention is causal-only")
+    _check_layout(layout, causal, q.shape[2])
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    out, _ = _ring_forward(q, k, v, axis, scale, impl, interpret)
+    fwd = _ring_forward_zigzag if layout == "zigzag" else _ring_forward
+    out, _ = fwd(q, k, v, axis, scale, impl, interpret)
     return out
 
 
-def _ring_fwd(q, k, v, axis, causal, scale, impl, interpret):
+def _ring_fwd(q, k, v, axis, causal, scale, impl, interpret, layout):
     # guard repeated here: under differentiation custom_vjp traces this
     # function instead of the primal body above
-    if not causal:
-        raise NotImplementedError("ring attention is causal-only")
+    _check_layout(layout, causal, q.shape[2])
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    out, lse = _ring_forward(q, k, v, axis, scale, impl, interpret)
+    fwd = _ring_forward_zigzag if layout == "zigzag" else _ring_forward
+    out, lse = fwd(q, k, v, axis, scale, impl, interpret)
     return out, (q, k, v, out, lse)
 
 
-def _ring_bwd(axis, causal, scale, impl, interpret, res, dout):
+def _ring_bwd(axis, causal, scale, impl, interpret, layout, res, dout):
     q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if layout == "zigzag":
+        return _ring_backward_zigzag(q, k, v, out, lse, dout, axis, scale,
+                                     impl, interpret)
     cp = jax.lax.axis_size(axis)
     r = jax.lax.axis_index(axis)
     n_rep = q.shape[1] // k.shape[1]
@@ -253,18 +415,32 @@ ring_attention.defvjp(_ring_fwd, _ring_bwd)
 def ring_attention_backend(q, k, v, *, causal: bool = True,
                            scale: Optional[float] = None, axis: str = "cp",
                            impl: Optional[str] = None,
-                           interpret: bool = False):
+                           interpret: bool = False,
+                           layout: Optional[str] = None):
     """Registry-compatible wrapper (backend name 'ring').
 
     Picks the flash-kernel block implementation on TPU, the XLA softmax
     fallback elsewhere (same policy as the 'flash' backend dispatch,
-    ops/flash_attention.py).
+    ops/flash_attention.py). The sequence layout defaults to the
+    ``SCALETORCH_TPU_CP_LAYOUT`` env toggle (set by the trainer from
+    ``cp_layout``) because model code calls backends as plain
+    ``fn(q, k, v, causal=, scale=)``.
     """
     if impl is None:
         from scaletorch_tpu.ops.flash_attention import _pallas_available
 
         impl = "pallas" if _pallas_available() else "xla"
-    return ring_attention(q, k, v, axis, causal, scale, impl, interpret)
+    if layout is None:
+        from scaletorch_tpu.env import get_env
+
+        layout = get_env("SCALETORCH_TPU_CP_LAYOUT")
+    return ring_attention(q, k, v, axis, causal, scale, impl, interpret, layout)
 
 
 register_attention_backend("ring", ring_attention_backend)
+# Explicit-layout variant: lets the spmd step thread cp_layout from config
+# without the env side-channel (the bare 'ring' name still honours
+# SCALETORCH_TPU_CP_LAYOUT for direct model calls).
+register_attention_backend(
+    "ring_zigzag", partial(ring_attention_backend, layout="zigzag")
+)

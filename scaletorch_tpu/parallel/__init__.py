@@ -9,8 +9,11 @@ from scaletorch_tpu.parallel.mesh import (  # noqa: F401
 )
 from scaletorch_tpu.parallel.pipeline_parallel import (  # noqa: F401
     make_llama_pipeline_loss,
+    pad_stacked_params,
+    padded_stage_counts,
     pipeline_spmd_loss,
     stage_layer_partition,
+    unpad_stacked_params,
     validate_pp_divisibility,
 )
 from scaletorch_tpu.parallel.fsdp import (  # noqa: F401
@@ -18,4 +21,14 @@ from scaletorch_tpu.parallel.fsdp import (  # noqa: F401
     make_fsdp_train_step,
     setup_fsdp,
     shard_params_fsdp,
+)
+from scaletorch_tpu.parallel.expert_parallel import (  # noqa: F401
+    sort_dispatch_tokens,
+    sort_gather_tokens,
+    sorted_moe_forward,
+)
+from scaletorch_tpu.parallel.zigzag import (  # noqa: F401
+    zigzag_batch,
+    zigzag_order,
+    zigzag_restore,
 )

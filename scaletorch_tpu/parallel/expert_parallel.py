@@ -223,6 +223,165 @@ def sorted_dispatch_reference(
     return x[sort_idx], sort_idx, counts
 
 
+# ---------------------------------------------------------------------------
+# Sort-based dispatch — the reference's ragged exchange, TPU-native
+# ---------------------------------------------------------------------------
+#
+# The reference's production dispatch is argsort-by-destination + count
+# exchange + 3 variable-split all-to-alls (ep_comms.py:41-133) — ZERO
+# token drops, ragged splits. XLA collectives want static shapes (and
+# XLA:CPU, the test backend, lacks ragged-all-to-all entirely), so the
+# exchange pads each destination chunk to a static per-peer capacity and
+# moves equal [ep, P] slabs with the dense ``all_to_all``; the ragged
+# truth lives in the exchanged size vector, exactly the reference's count
+# all-to-all. This path trades the capacity path's token drops for masked
+# compute: every local expert runs over the whole receive buffer with a
+# membership mask (E_local× the matmul work), so it suits
+# correctness-critical flows and low expert counts; the capacity path
+# stays the throughput default (dense MXU slots, bounded memory).
+
+def _excl_cumsum(x):
+    return jnp.concatenate([jnp.zeros((1,), x.dtype), jnp.cumsum(x)[:-1]])
+
+
+def sort_dispatch_tokens(
+    x: jax.Array,
+    expert_ids: jax.Array,
+    *,
+    axis: str,
+    num_experts: int,
+    chunk_capacity: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Reference-parity sort-based dispatch (ep_comms.py:41-133) in jit.
+
+    x: [N, H] local (token·choice) rows; expert_ids: [N] global expert of
+    each row. Stable-argsorts rows by destination rank, scatters them
+    into per-destination slabs of ``chunk_capacity`` rows (default N —
+    the zero-drop worst case; smaller values bound memory but can drop
+    under extreme skew), exchanges the slabs, and returns
+
+      recv_x     [ep·P, H]  received rows, grouped by source rank
+      recv_local [ep·P]     each row's LOCAL expert index; E_local (an
+                            invalid id) marks empty slots
+      recv_valid [ep·P]     bool mask of filled slots
+      meta                  bookkeeping consumed by ``sort_gather_tokens``
+
+    Invariant parity with reference test_ep_comms.py:69-96: chunk sizes
+    sum to N, the send permutation is stable within destination groups,
+    and every received id falls in this rank's local range.
+    """
+    ep = jax.lax.axis_size(axis)
+    n, h = x.shape
+    e_local = num_experts // ep
+    p = chunk_capacity or n
+    me = jax.lax.axis_index(axis)
+
+    x = pvary_missing(x, axis)
+    expert_ids = pvary_missing(expert_ids, axis)
+    dest = expert_ids // e_local
+    order = jnp.argsort(dest, stable=True)
+    x_s = x[order]
+    ids_s = expert_ids[order]
+    dest_s = dest[order]
+    send_sizes = jnp.bincount(dest, length=ep)          # [ep]
+    slot = jnp.arange(n) - _excl_cumsum(send_sizes)[dest_s]
+
+    # pad each destination's chunk into a static [ep, P] slab; rows past
+    # the slab (only possible when chunk_capacity < its send size) drop
+    send_x = jnp.zeros((ep, p, h), x.dtype).at[dest_s, slot].set(
+        x_s, mode="drop")
+    send_ids = jnp.full((ep, p), num_experts, ids_s.dtype).at[
+        dest_s, slot].set(ids_s, mode="drop")
+
+    # the reference's count all-to-all + 2 payload all-to-alls
+    recv_sizes = jax.lax.all_to_all(
+        send_sizes[:, None], axis, split_axis=0, concat_axis=0)[:, 0]
+    recv_x = jax.lax.all_to_all(send_x, axis, split_axis=0, concat_axis=0)
+    recv_ids = jax.lax.all_to_all(send_ids, axis, split_axis=0, concat_axis=0)
+
+    recv_valid = (
+        jnp.arange(p)[None, :] < jnp.minimum(recv_sizes, p)[:, None]
+    ).reshape(-1)
+    recv_local = jnp.where(
+        recv_valid, recv_ids.reshape(-1) - me * e_local, e_local)
+    meta = {"order": order, "dest_s": dest_s, "slot": slot, "n": n, "p": p}
+    return recv_x.reshape(ep * p, h), recv_local, recv_valid, meta
+
+
+def sort_gather_tokens(
+    expert_out: jax.Array, meta: Dict[str, jax.Array], *, axis: str
+) -> jax.Array:
+    """Return expert outputs to their source ranks and restore the
+    original row order (reference gather_tokens, ep_comms.py:136-171).
+    expert_out: [ep·P, H] in the receive-slab layout. Returns [N, H]."""
+    ep = jax.lax.axis_size(axis)
+    p, n = meta["p"], meta["n"]
+    h = expert_out.shape[-1]
+    back = jax.lax.all_to_all(
+        expert_out.reshape(ep, p, h), axis, split_axis=0, concat_axis=0)
+    # slab [d, slot] holds the result of sorted row with that (dest, slot);
+    # rows that overflowed the slab were never exchanged — they must come
+    # back as zeros, not as the clamped gather's copy of the last slot
+    kept = meta["slot"] < p
+    sorted_back = jnp.where(
+        kept[:, None],
+        back[meta["dest_s"], jnp.minimum(meta["slot"], p - 1)],
+        0,
+    )
+    # un-sort: row i of the send order was x[order[i]]
+    return jnp.zeros((n, h), back.dtype).at[meta["order"]].set(sorted_back)
+
+
+def sorted_moe_forward(
+    x: jax.Array,
+    gate_idx: jax.Array,
+    gate_w: jax.Array,
+    gate_proj: jax.Array,
+    up_proj: jax.Array,
+    down_proj: jax.Array,
+    *,
+    axis: Optional[str] = None,
+    num_experts: int,
+    chunk_capacity: Optional[int] = None,
+    compute_dtype: Any = None,
+) -> jax.Array:
+    """Zero-drop MoE forward over the sort-based exchange.
+
+    x: [N, H]; gate_idx/gate_w: [N, k] top-k expert ids and weights;
+    gate/up/down_proj: local expert weights [E_local, H, I]/[E_local, I, H].
+    Returns [N, H]. With ``axis=None`` runs single-rank (E_local = E),
+    the world_size==1 no-op contract.
+    """
+    n, h = x.shape
+    k = gate_idx.shape[-1]
+    cdt = compute_dtype or x.dtype
+    flat_x = jnp.repeat(x, k, axis=0)                 # row n·k+j = choice j
+    flat_ids = gate_idx.reshape(-1)
+
+    if axis is None:
+        recv, local_ids, valid = flat_x, flat_ids, jnp.ones(n * k, bool)
+    else:
+        recv, local_ids, valid, meta = sort_dispatch_tokens(
+            flat_x, flat_ids, axis=axis, num_experts=num_experts,
+            chunk_capacity=chunk_capacity)
+
+    from scaletorch_tpu.models.layers import swiglu
+
+    e_local = gate_proj.shape[0]
+    recv_c = jnp.where(valid[:, None], recv, 0).astype(cdt)
+    out = jnp.zeros(recv.shape, cdt)
+    for e in range(e_local):  # static loop; each expert masks its rows
+        mask = (local_ids == e)[:, None]
+        g = recv_c @ gate_proj[e].astype(cdt)
+        u = recv_c @ up_proj[e].astype(cdt)
+        out = out + jnp.where(mask, swiglu(g, u) @ down_proj[e].astype(cdt), 0)
+
+    if axis is not None:
+        out = sort_gather_tokens(out, meta, axis=axis)
+    y = out.reshape(n, k, h) * gate_w[..., None].astype(cdt)
+    return jnp.sum(y, axis=1)
+
+
 def validate_ep_divisibility(cfg, ep: int) -> None:
     """Experts shard evenly over the ep axis (reference
     model_qwen3_moe.py:192-207 requires num_experts % ep_size == 0)."""

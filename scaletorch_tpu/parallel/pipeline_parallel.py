@@ -106,6 +106,66 @@ def validate_pp_divisibility(cfg, pp: int) -> None:
         )
 
 
+def padded_stage_counts(num_layers: int, pp: int) -> tuple[List[int], int]:
+    """(real-layer count per stage, padded slots per stage). The stacked
+    layer axis is padded to ``pp * slots`` so it shards evenly; each
+    stage's trailing ``slots - counts[s]`` entries are identity padding
+    masked out of compute (decoder_stack ``active_layers``)."""
+    counts = [len(g) for g in stage_layer_partition(num_layers, pp)]
+    return counts, max(counts)
+
+
+def pad_stacked_params(layers: Any, num_layers: int, pp: int) -> Any:
+    """Re-block stacked [L, ...] layer leaves into [pp·slots, ...] so that
+    stage s's pp-shard holds its partition's real layers followed by
+    zero padding — the uneven-layer support the reference gets from
+    per-stage module lists (pipeline_parallel.py:83-133). Zero (finite)
+    padding keeps masked compute NaN-free; the mask guarantees zero
+    gradients, so the pad rows never train."""
+    counts, slots = padded_stage_counts(num_layers, pp)
+    if slots * pp == num_layers:
+        return layers
+    bounds = [0]
+    for c in counts:
+        bounds.append(bounds[-1] + c)
+
+    def pad_leaf(w):
+        blocks = []
+        for s, c in enumerate(counts):
+            blk = w[bounds[s]:bounds[s + 1]]
+            if c < slots:
+                blk = jnp.concatenate(
+                    [blk, jnp.zeros((slots - c,) + w.shape[1:], w.dtype)], 0)
+            blocks.append(blk)
+        return jnp.concatenate(blocks, 0)
+
+    return jax.tree.map(pad_leaf, layers)
+
+
+def unpad_stacked_params(layers: Any, num_layers: int, pp: int) -> Any:
+    """Inverse of ``pad_stacked_params`` (checkpoint/HF export: the model's
+    true layer order, padding removed)."""
+    counts, slots = padded_stage_counts(num_layers, pp)
+    if slots * pp == num_layers:
+        return layers
+    keep = []
+    for s, c in enumerate(counts):
+        keep.extend(range(s * slots, s * slots + c))
+    idx = jnp.asarray(keep)
+    return jax.tree.map(lambda w: w[idx], layers)
+
+
+def _stage_active_layers(
+    num_layers: int, pp: int, pp_axis: str, axes: Sequence[str]
+) -> Optional[jax.Array]:
+    """Per-stage real-layer count as a traced scalar (None when even)."""
+    counts, slots = padded_stage_counts(num_layers, pp)
+    if slots * pp == num_layers:
+        return None
+    stage = jax.lax.axis_index(pp_axis)
+    return pvary_missing(jnp.asarray(counts, jnp.int32)[stage], tuple(axes))
+
+
 def pipeline_spmd_loss(
     params: Dict[str, Any],
     batch: Dict[str, jax.Array],
@@ -264,12 +324,12 @@ def make_llama_pipeline_loss(
         fused_vocab_parallel_cross_entropy,
     )
 
-    validate_pp_divisibility(model_cfg, mm.pp)
     attn_fn = get_attention_backend(attention_backend)
     if head_weight_fn is None:
         head_weight_fn = llama.lm_head_weight
     tp = tp_axis if mm.tp > 1 else None
     sp = sequence_parallel and mm.tp > 1
+    axes = ("dp", "cp", "ep", "tp", "pp")
 
     def embed_fn(params, ids_t):
         return llama.embed(params, ids_t, model_cfg, tp_axis=tp,
@@ -280,13 +340,16 @@ def make_llama_pipeline_loss(
             pos_t.shape[0], model_cfg.actual_head_dim, model_cfg.rope_theta,
             positions=pos_t,
         )
-        # params["layers"] leaves arrive pp-sharded: leading dim = L / pp,
+        # params["layers"] leaves arrive pp-sharded: leading dim = L / pp
+        # (or the padded slot count for uneven L — pad_stacked_params),
         # i.e. exactly this stage's contiguous layer block.
         return llama.decoder_stack(
             x, params["layers"], cos, sin, model_cfg, attn_fn,
             tp_axis=tp, sequence_parallel=sp,
             gradient_checkpointing=gradient_checkpointing,
             remat_policy=remat_policy,
+            active_layers=_stage_active_layers(
+                model_cfg.num_hidden_layers, mm.pp, pp_axis, axes),
         )
 
     def loss_fn(params, x_m, t_m):
@@ -335,7 +398,6 @@ def make_moe_pipeline_loss(
         fused_vocab_parallel_cross_entropy,
     )
 
-    validate_pp_divisibility(model_cfg, mm.pp)
     attn_fn = get_attention_backend(attention_backend)
     if head_weight_fn is None:
         head_weight_fn = qwen3_moe.lm_head_weight
@@ -343,6 +405,7 @@ def make_moe_pipeline_loss(
     ep = ep_axis if mm.ep > 1 else None
     sp = sequence_parallel and mm.tp > 1
     helpers = llama.tp_region_helpers(model_cfg, tp, sp)
+    axes = ("dp", "cp", "ep", "tp", "pp")
 
     def embed_fn(params, ids_t):
         return llama.embed(params, ids_t, model_cfg, tp_axis=tp,
@@ -358,6 +421,8 @@ def make_moe_pipeline_loss(
             tp_axis=tp, ep_axis=ep, sequence_parallel=sp,
             gradient_checkpointing=gradient_checkpointing,
             remat_policy=remat_policy,
+            active_layers=_stage_active_layers(
+                model_cfg.num_hidden_layers, mm.pp, pp_axis, axes),
         )
 
     def loss_fn(params, x_m, t_m):

@@ -125,10 +125,18 @@ def _build_losses(
     model_kwargs: Optional[Dict[str, Any]],
     model_family: str,
     pp_schedule: str,
+    cp_layout: str = "contiguous",
+    custom_pipeline_loss: Optional[Callable] = None,
+    custom_pipeline_has_aux: bool = False,
 ) -> Tuple[Callable, Optional[Callable], bool]:
     """(loss_fn, pipe_loss, pipe_has_aux) — the per-microbatch loss for the
     non-PP path and, when mm.pp > 1, the pipeline loss. Shared by the
     train step and the eval step so both compute the identical objective."""
+    if attention_backend == "ring" and cp_layout == "zigzag":
+        # explicit-layout registry alias: the zigzag masking schedule must
+        # be traced into THIS step (ops/ring_attention.py), not left to the
+        # env default a non-Trainer caller may never set
+        attention_backend = "ring_zigzag"
 
     def loss_fn(p, mb):
         out = model_forward(
@@ -167,6 +175,12 @@ def _build_losses(
 
     if pp_schedule not in ("afab", "1f1b"):
         raise ValueError(f"pp_schedule must be 'afab' or '1f1b', got {pp_schedule}")
+    if custom_pipeline_loss is not None:
+        # Custom model families run PP through the public protocol: build
+        # a ``(params, batch) -> loss`` with pipeline_spmd_loss over your
+        # own embed_fn/stage_fn/loss_fn (see pipeline_parallel.py
+        # docstring) and hand it in here.
+        return loss_fn, custom_pipeline_loss, custom_pipeline_has_aux
     if model_family == "qwen3_moe":
         # PP x EP: each stage's MoE layers run the ep all-to-all inside
         # stage compute; live-tick aux losses ride the pipeline carry
@@ -185,14 +199,16 @@ def _build_losses(
         )
         return loss_fn, pipe_loss, True
     if custom_param_specs:
-        # The PP path composes the built-in pipeline pieces (embed /
-        # decoder_stack / final_hidden) over the pp-sharded stacked
+        # The built-in PP path composes Llama/Qwen3 pipeline pieces (embed
+        # / decoder_stack / final_hidden) over the pp-sharded stacked
         # layer axis; a custom params tree would be silently trained
-        # against the wrong computation.
+        # against the wrong computation. Custom families opt in by
+        # passing ``custom_pipeline_loss`` (the pipeline_spmd_loss
+        # protocol) handled above.
         raise NotImplementedError(
-            "pp > 1 supports the built-in Llama/Qwen3/Qwen3-MoE "
-            "families only (custom param_specs/model_forward not yet "
-            "wired into the pipeline schedule)"
+            "pp > 1 with a custom params tree needs a custom_pipeline_loss: "
+            "build one with pipeline_parallel.pipeline_spmd_loss over your "
+            "embed_fn/stage_fn/loss_fn and pass it to make_spmd_train_step"
         )
     from scaletorch_tpu.parallel.pipeline_parallel import (
         make_llama_pipeline_loss,
@@ -220,6 +236,7 @@ def make_spmd_eval_step(
     param_specs: Any = None,
     model_kwargs: Optional[Dict[str, Any]] = None,
     model_family: str = "llama",
+    cp_layout: str = "contiguous",
 ) -> Tuple[Callable, Any]:
     """Jitted validation step ``(params, batch) -> loss`` over the same 5D
     mesh and loss form as the train step, minus backward/update — the
@@ -247,6 +264,7 @@ def make_spmd_eval_step(
         model_kwargs=model_kwargs,
         model_family=model_family,
         pp_schedule="afab",
+        cp_layout=cp_layout,
     )
     all_axes = DATA_AXES + ("ep",) + (("tp", "pp") if use_pp else ("tp",))
 
@@ -298,6 +316,9 @@ def make_spmd_train_step(
     pp_schedule: str = "afab",
     model_kwargs: Optional[Dict[str, Any]] = None,
     model_family: str = "llama",
+    cp_layout: str = "contiguous",
+    custom_pipeline_loss: Optional[Callable] = None,
+    custom_pipeline_has_aux: bool = False,
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -345,6 +366,9 @@ def make_spmd_train_step(
         model_kwargs=model_kwargs,
         model_family=model_family,
         pp_schedule=pp_schedule,
+        cp_layout=cp_layout,
+        custom_pipeline_loss=custom_pipeline_loss,
+        custom_pipeline_has_aux=custom_pipeline_has_aux,
     )
 
     # 'ep' is always a data axis for the batch (batch_specs shards rows
@@ -424,15 +448,13 @@ def make_spmd_train_step(
             # Pick 'afab' unless boundary-activation memory is the binding
             # constraint (scripts/benchmark_comprehensive.py measures both).
             chunk = mm.pp
-            if accum % chunk != 0:
-                raise ValueError(
-                    f"1f1b schedule needs grad_accum ({accum}) divisible by pp "
-                    f"({chunk}); use afab or adjust grad_accum"
-                )
-            nchunks = accum // chunk
-            batch_c = jax.tree.map(
-                lambda x: x.reshape((nchunks, chunk) + x.shape[1:]), batch
-            )
+            # accum need not divide pp: full chunks run under the scan and
+            # a shorter remainder pipeline pass (rem < pp microbatches,
+            # just a bigger bubble) covers the tail — the reference 1F1B
+            # handles any M >= 1 the same way (pipeline_parallel.py:457-671).
+            # Every pass returns a mean over ITS microbatches, so passes
+            # are recombined weighted by their microbatch counts.
+            nfull, rem = divmod(accum, chunk)
             from scaletorch_tpu.parallel.pipeline_parallel import (
                 MOE_PIPELINE_STATS,
             )
@@ -452,12 +474,31 @@ def make_spmd_train_step(
                     None,
                 )
 
-            (grads, loss_sum, extras_sum), _ = jax.lax.scan(
-                chunk_step, (zeros, zero_l, extras0), batch_c
-            )
-            grads = jax.tree.map(lambda g: g / nchunks, grads)
-            loss = loss_sum / nchunks
-            extras = {k: v / nchunks for k, v in extras_sum.items()}
+            if nfull:
+                batch_c = jax.tree.map(
+                    lambda x: x[:nfull * chunk].reshape(
+                        (nfull, chunk) + x.shape[1:]), batch
+                )
+                (g_sum, l_sum, e_sum), _ = jax.lax.scan(
+                    chunk_step, (zeros, zero_l, extras0), batch_c
+                )
+            else:
+                g_sum, l_sum, e_sum = zeros, zero_l, extras0
+            # per-microbatch totals: each full chunk's mean covers `chunk`
+            # microbatches
+            grads = jax.tree.map(lambda g: g * chunk, g_sum)
+            loss = l_sum * chunk
+            extras = {k: v * chunk for k, v in e_sum.items()}
+            if rem:
+                batch_r = jax.tree.map(lambda x: x[nfull * chunk:], batch)
+                l_r, e_r, g_r = pipe_value_and_grad(p_v, batch_r)
+                grads = jax.tree.map(
+                    lambda a, b: a + b.astype(jnp.float32) * rem, grads, g_r)
+                loss = loss + l_r * rem
+                extras = {k: extras[k] + e_r[k] * rem for k in extras}
+            grads = jax.tree.map(lambda g: g / accum, grads)
+            loss = loss / accum
+            extras = {k: v / accum for k, v in extras.items()}
         elif accum == 1:
             # No accumulation: differentiate the single microbatch directly.
             # The scan below would carry an fp32 zeros tree (a full extra

@@ -41,18 +41,32 @@ Batch = Dict[str, jax.Array]  # input_ids/target_ids: [accum, micro_bs, seq]
 
 def make_loss_fn(forward: Callable, cfg, *, attention_backend: str,
                  gradient_checkpointing: bool) -> Callable:
-    """loss(params, microbatch) -> scalar fp32."""
+    """loss(params, microbatch) -> scalar fp32.
+
+    MoE forwards carry a router aux loss that MUST join the objective
+    (reference train_step adds model.get_aux_loss(); the spmd step and the
+    pipeline path both do) — forwards exposing ``return_moe_stats`` are
+    asked for it and the coefficient-scaled sum is added to the CE.
+    """
+    import inspect
+
+    wants_aux = "return_moe_stats" in inspect.signature(forward).parameters
 
     def loss_fn(params, mb: Batch) -> jax.Array:
-        logits = forward(
+        out = forward(
             params,
             mb["input_ids"],
             cfg,
             positions=mb.get("position_ids"),
             attention_backend=attention_backend,
             gradient_checkpointing=gradient_checkpointing,
+            **({"return_moe_stats": True} if wants_aux else {}),
         )
-        return cross_entropy_loss(logits, mb["target_ids"])
+        if wants_aux:
+            logits, aux = out[0], out[1]
+        else:
+            logits, aux = out, 0.0
+        return cross_entropy_loss(logits, mb["target_ids"]) + aux
 
     return loss_fn
 

@@ -8,6 +8,7 @@ of the jitted step rather than per-rank module surgery.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -177,6 +178,15 @@ class Trainer:
                 f"context_parallel_size={cfg.context_parallel_size} requires the "
                 f"'ring' attention backend, got {self.attention_backend!r}"
             )
+        # CP sequence layout: the ring backend reads the env toggle at trace
+        # time (model code calls backends without layout kwargs), and
+        # _device_batch applies the matching host-side token permutation.
+        self._zigzag_cp = (
+            cfg.context_parallel_size > 1 and cfg.cp_layout == "zigzag"
+        )
+        os.environ["SCALETORCH_TPU_CP_LAYOUT"] = (
+            "zigzag" if self._zigzag_cp else "contiguous"
+        )
 
         from scaletorch_tpu.parallel.spmd import (
             batch_specs,
@@ -257,6 +267,25 @@ class Trainer:
             with jax.default_device(jax.local_devices()[0]):
                 params_host = init_fn(key, self.model_cfg)
 
+        if (cfg.pipeline_parallel_size > 1
+                and self.model_cfg.num_hidden_layers
+                % cfg.pipeline_parallel_size):
+            # Uneven PP: pad the stacked layer axis so it shards evenly;
+            # the pipeline stage compute masks the padding slots out
+            # (pipeline_parallel.pad_stacked_params / decoder_stack
+            # active_layers). Reference parity: ragged per-stage layer
+            # counts, pipeline_parallel.py:83-133.
+            from scaletorch_tpu.parallel.pipeline_parallel import (
+                pad_stacked_params,
+            )
+
+            params_host = dict(params_host)
+            params_host["layers"] = pad_stacked_params(
+                params_host["layers"],
+                self.model_cfg.num_hidden_layers,
+                cfg.pipeline_parallel_size,
+            )
+
         # clip-free optimizer: the SPMD step applies TP-correct clipping.
         # Adafactor additionally needs the param layout + mesh sizes so its
         # factored statistics reduce across sharded dims (trainer/factored.py).
@@ -293,6 +322,7 @@ class Trainer:
             max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
             pp_schedule=cfg.pp_engine,
+            cp_layout=cfg.cp_layout,
             param_specs=param_specs,
             model_kwargs=model_kwargs,
             head_weight_fn=head_weight_fn,
@@ -346,6 +376,7 @@ class Trainer:
                 param_specs=param_specs,
                 model_kwargs=model_kwargs,
                 model_family="qwen3_moe" if is_moe else "llama",
+                cp_layout=cfg.cp_layout,
             )
             self._eval_loader = self._build_eval_loader()
 
@@ -434,6 +465,14 @@ class Trainer:
         # shards of the (deterministic, identical) host batch multi-process.
         from scaletorch_tpu.dist import put_global
 
+        if self._zigzag_cp:
+            # Zigzag CP: permute the token order so the contiguous 'cp'
+            # sequence sharding hands each ring rank its stripe pair
+            # (parallel/zigzag.py); position_ids ride along, keeping RoPE
+            # and the loss layout-transparent.
+            from scaletorch_tpu.parallel.zigzag import zigzag_batch
+
+            batch = zigzag_batch(batch, self.cfg.context_parallel_size)
         return {
             k: put_global(np.asarray(v), self._batch_shardings[k])
             for k, v in batch.items()

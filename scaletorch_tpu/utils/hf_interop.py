@@ -372,6 +372,18 @@ def save_hf_params(
 
     if dtype not in ("float32", "bfloat16"):
         raise ValueError(f"dtype must be float32|bfloat16, got {dtype!r}")
+    n_stacked = next(iter(params["layers"].values())).shape[0]
+    if n_stacked != cfg.num_hidden_layers:
+        # Uneven-PP trees carry identity padding slots at stage boundaries
+        # (pipeline_parallel.pad_stacked_params); the pad layout depends on
+        # pp, which the shape alone cannot disambiguate — the caller must
+        # strip it first.
+        raise ValueError(
+            f"params carry {n_stacked} stacked layers but the config has "
+            f"{cfg.num_hidden_layers}: unpad uneven-pipeline padding first "
+            f"(pipeline_parallel.unpad_stacked_params(params['layers'], "
+            f"{cfg.num_hidden_layers}, pp))"
+        )
     os.makedirs(path, exist_ok=True)
     esize = 2 if dtype == "bfloat16" else 4
 

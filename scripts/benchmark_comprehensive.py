@@ -79,24 +79,37 @@ CORRECTNESS_CONFIGS = [
 
 # The reference's published 8-chip rows (BASELINE.md §8-NPU) + single-chip
 # rows; run on a real pod/chip. World size must equal available devices.
+# The optional trailing dict carries training-recipe extras (param_dtype /
+# optimizer_name) — the SAME memory recipes bench.py's single-chip rows
+# use (bench.py SINGLE_CHIP_ROWS): 1.7B needs bf16 master weights and 4B
+# needs Adafactor to fit a 16 GB chip; without them this table OOMs where
+# bench.py's rows run, and the two tables silently disagree.
 PERF_CONFIGS = [
     ("0.6B-single",          "qwen3-0.6b", 1, 1, 1, 1, 1, 1, 1, 8192,  True,  False, "1f1b"),
     ("0.6B-seq16k-single",   "qwen3-0.6b", 1, 1, 1, 1, 1, 1, 1, 16384, True,  False, "1f1b"),
     ("0.6B-DP8",             "qwen3-0.6b", 1, 1, 8, 1, 1, 2, 2, 2048,  False, False, "1f1b"),
     ("0.6B-CP2-DP4",         "qwen3-0.6b", 1, 1, 4, 2, 1, 1, 1, 4096,  False, False, "1f1b"),
-    ("1.7B-DP8-GC",          "qwen3-1.7b", 1, 1, 8, 1, 1, 1, 2, 2048,  True,  False, "1f1b"),
-    ("1.7B-CP4-DP2-GC",      "qwen3-1.7b", 1, 1, 2, 4, 1, 1, 1, 8192,  True,  False, "1f1b"),
-    ("4B-CP2-DP4-GC",        "qwen3-4b",   1, 1, 4, 2, 1, 1, 1, 4096,  True,  False, "1f1b"),
-    ("8B-TP2-CP2-DP2-GC",    "qwen3-8b",   2, 1, 2, 2, 1, 1, 1, 4096,  True,  False, "1f1b"),
-    ("14B-TP4-CP2-GC",       "qwen3-14b",  4, 1, 1, 2, 1, 1, 1, 4096,  True,  False, "1f1b"),
-    ("32B-TP8-SEQ4K-GC",     "qwen3-32b",  8, 1, 1, 1, 1, 1, 1, 4096,  True,  False, "1f1b"),
-    ("30B-A3B-EP2-TP4",      "qwen3-30b-a3b", 4, 1, 1, 1, 2, 1, 1, 4096, False, False, "1f1b"),
+    ("1.7B-DP8-GC",          "qwen3-1.7b", 1, 1, 8, 1, 1, 1, 2, 2048,  True,  False, "1f1b",
+     {"param_dtype": "bfloat16"}),
+    ("1.7B-CP4-DP2-GC",      "qwen3-1.7b", 1, 1, 2, 4, 1, 1, 1, 8192,  True,  False, "1f1b",
+     {"param_dtype": "bfloat16"}),
+    ("4B-CP2-DP4-GC",        "qwen3-4b",   1, 1, 4, 2, 1, 1, 1, 4096,  True,  False, "1f1b",
+     {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
+    ("8B-TP2-CP2-DP2-GC",    "qwen3-8b",   2, 1, 2, 2, 1, 1, 1, 4096,  True,  False, "1f1b",
+     {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
+    ("14B-TP4-CP2-GC",       "qwen3-14b",  4, 1, 1, 2, 1, 1, 1, 4096,  True,  False, "1f1b",
+     {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
+    ("32B-TP8-SEQ4K-GC",     "qwen3-32b",  8, 1, 1, 1, 1, 1, 1, 4096,  True,  False, "1f1b",
+     {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
+    ("30B-A3B-EP2-TP4",      "qwen3-30b-a3b", 4, 1, 1, 1, 2, 1, 1, 4096, False, False, "1f1b",
+     {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
 ]
 # fmt: on
 
 
 def build_cmd(cfg, steps, perf_dir):
-    (label, model, tp, pp, dp, cp, ep, bs, ga, seq, gc, sp, engine) = cfg
+    (label, model, tp, pp, dp, cp, ep, bs, ga, seq, gc, sp, engine) = cfg[:13]
+    extra = cfg[13] if len(cfg) > 13 else {}
     from scaletorch_tpu.models.presets import preset
 
     cmd = [sys.executable, os.path.join(REPO, "train.py")]
@@ -121,6 +134,8 @@ def build_cmd(cfg, steps, perf_dir):
         "--log_frequency", "1",
         "--performance_log_dir", perf_dir,
     ]
+    for k, v in extra.items():
+        cmd += [f"--{k}", str(v)]
     return cmd
 
 
@@ -130,11 +145,22 @@ def world_size(cfg) -> int:
 
 
 def load_perf_json(perf_dir, warmup):
-    """Read the trainer's dumped metrics history (MetricsLogger.save_json)."""
+    """Read the trainer's dumped metrics history (MetricsLogger.save_json).
+
+    Files are named ``performance_log_proc{P}_step{S}.json``; pick process
+    0's latest step deterministically — a lexicographic sort would grab an
+    arbitrary process on multi-process runs (metrics are replicated, but
+    the choice should not depend on process count)."""
+    def _key(name):
+        m = re.search(r"proc(\d+)_step(\d+)", name)
+        # max() picks: lowest process index, then its highest step;
+        # unparseable names lose to any real dump
+        return (-(10 ** 9), 0) if not m else (-int(m.group(1)), int(m.group(2)))
+
     files = [f for f in os.listdir(perf_dir) if f.endswith(".json")]
     if not files:
         return None
-    with open(os.path.join(perf_dir, sorted(files)[-1])) as f:
+    with open(os.path.join(perf_dir, max(files, key=_key))) as f:
         data = json.load(f)
     steady = [r for r in data.get("records", [])
               if r.get("step", 0) > warmup and "tokens_per_second" in r]

@@ -63,6 +63,9 @@ FAKE_CHILD = textwrap.dedent(
         }), flush=True)
     if beh == "ok_then_hang":           # result printed, teardown stalls
         time.sleep(600)
+    if beh == "ok_then_wedge":          # result printed, teardown ignores SIGINT
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        time.sleep(600)
     """
 )
 
@@ -143,6 +146,17 @@ def test_result_kept_when_child_stalls_in_teardown(fake_bench, capsys):
     assert line["late_exit"] is True
 
 
+def test_wedged_banked_child_skips_the_pallas_experiment(fake_bench, capsys):
+    """A result-then-wedge child holds the chip: the banked number is
+    reported but NO further device subprocess may be launched at it."""
+    fake_bench(sdpa_row="ok_then_wedge", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=99.0)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 45.4  # the pallas row must never have run
+    assert "chip held" in line["pallas_skipped"]
+
+
 def test_dead_tunnel_fails_fast_with_classified_error(fake_bench, capsys,
                                                       monkeypatch):
     monkeypatch.setenv("BENCH_ROW_BUDGET", "2")
@@ -185,6 +199,18 @@ def test_table_mode_short_circuits_after_wedge(fake_bench, capsys, monkeypatch):
     assert all("skipped: chip wedged" in s for s in statuses[1:])
     line = _stdout_line(capsys)
     assert line["metric"] == "error"
+
+
+def test_stale_child_mode_env_cannot_hijack_children(fake_bench, capsys,
+                                                     monkeypatch):
+    """An exported BENCH_PREFLIGHT=1 left over from manual debugging must
+    not turn every orchestration child into a preflight."""
+    monkeypatch.setenv("BENCH_PREFLIGHT", "1")
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=52.0)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 52.0  # real rows ran, not preflights
 
 
 def test_last_stage_parser():

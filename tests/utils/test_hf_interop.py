@@ -282,3 +282,28 @@ class TestShardedBf16Save:
             _hf_logits(reloaded, ids), _hf_logits(model, ids),
             rtol=5e-2, atol=5e-2,
         )
+
+
+def test_save_rejects_padded_uneven_pp_tree(tmp_path):
+    """A padded uneven-PP layer stack must not silently export pad rows
+    as real layers — the pad layout is pp-dependent and needs explicit
+    unpadding."""
+    import jax
+
+    from scaletorch_tpu.models.llama import LlamaConfig, init_params
+    from scaletorch_tpu.parallel.pipeline_parallel import (
+        pad_stacked_params,
+        unpad_stacked_params,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=3, num_attention_heads=2, num_key_value_heads=2,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    padded = dict(params, layers=pad_stacked_params(params["layers"], 3, 2))
+    with pytest.raises(ValueError, match="unpad"):
+        save_hf_params(str(tmp_path / "x"), padded, cfg)
+    # and the documented fix round-trips
+    fixed = dict(padded, layers=unpad_stacked_params(padded["layers"], 3, 2))
+    save_hf_params(str(tmp_path / "ok"), fixed, cfg)

@@ -123,6 +123,7 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
         model_kwargs={"ep_axis": "ep" if ep > 1 else None} if is_moe else None,
         model_family="qwen3_moe" if is_moe else "llama",
         pp_schedule=cfg.pp_engine,
+        cp_layout=cfg.cp_layout,
     )
     opt_state = jax.eval_shape(tx.init, params)
     rows = micro_bs * dp * ep
