@@ -438,9 +438,13 @@ def ring_attention_backend(q, k, v, *, causal: bool = True,
 
 
 register_attention_backend("ring", ring_attention_backend)
-# Explicit-layout variant: lets the spmd step thread cp_layout from config
-# without the env side-channel (the bare 'ring' name still honours
-# SCALETORCH_TPU_CP_LAYOUT for direct model calls).
+# Explicit-layout variants: let the spmd step pin cp_layout from config at
+# trace time for BOTH layouts — the bare 'ring' name reads the
+# SCALETORCH_TPU_CP_LAYOUT env at trace time, which is process-global and
+# therefore unsafe when steps with different layouts trace in one process.
 register_attention_backend(
     "ring_zigzag", partial(ring_attention_backend, layout="zigzag")
+)
+register_attention_backend(
+    "ring_contiguous", partial(ring_attention_backend, layout="contiguous")
 )

@@ -132,11 +132,12 @@ def _build_losses(
     """(loss_fn, pipe_loss, pipe_has_aux) — the per-microbatch loss for the
     non-PP path and, when mm.pp > 1, the pipeline loss. Shared by the
     train step and the eval step so both compute the identical objective."""
-    if attention_backend == "ring" and cp_layout == "zigzag":
-        # explicit-layout registry alias: the zigzag masking schedule must
-        # be traced into THIS step (ops/ring_attention.py), not left to the
-        # env default a non-Trainer caller may never set
-        attention_backend = "ring_zigzag"
+    if attention_backend == "ring":
+        # explicit-layout registry alias: the layout's masking schedule
+        # must be traced into THIS step (ops/ring_attention.py), never
+        # left to the process-global env default — another Trainer in the
+        # same process may have set it to the other layout
+        attention_backend = f"ring_{cp_layout}"
 
     def loss_fn(p, mb):
         out = model_forward(
@@ -342,6 +343,26 @@ def make_spmd_train_step(
     config.py:155-173) — the accum dim of the batch is the microbatch dim.
     """
     use_pp = mm.pp > 1
+    if (use_pp and custom_pipeline_loss is None
+            and isinstance(params, dict) and "layers" in params):
+        # The stacked layer axis must shard evenly over pp. For uneven
+        # layer counts the caller pads first (the Trainer does this
+        # automatically) — catching it here gives a clear error instead
+        # of a shard_map divisibility failure deep in tracing.
+        from scaletorch_tpu.parallel.pipeline_parallel import (
+            padded_stage_counts,
+        )
+
+        lead = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
+        _, slots = padded_stage_counts(model_cfg.num_hidden_layers, mm.pp)
+        if lead != slots * mm.pp:
+            raise ValueError(
+                f"stacked layer axis has {lead} slots but pp={mm.pp} with "
+                f"num_hidden_layers={model_cfg.num_hidden_layers} needs "
+                f"{slots * mm.pp}; pad uneven layer counts first with "
+                f"pipeline_parallel.pad_stacked_params(params['layers'], "
+                f"{model_cfg.num_hidden_layers}, {mm.pp})"
+            )
     p_specs = (
         param_specs
         if param_specs is not None

@@ -18,15 +18,14 @@ masking schedule needs to know (ops/ring_attention.py layout='zigzag').
 
 from __future__ import annotations
 
+from functools import lru_cache
 from typing import Dict
 
 import numpy as np
 
 
-def zigzag_order(seq_len: int, cp: int) -> np.ndarray:
-    """new_index -> old_index map: position i of the permuted sequence
-    holds original token order[i]. Rank r's contiguous slice of the
-    permuted sequence is [stripe_r, stripe_{2cp-1-r}]."""
+@lru_cache(maxsize=32)
+def _order_cached(seq_len: int, cp: int) -> np.ndarray:
     if seq_len % (2 * cp):
         raise ValueError(
             f"zigzag needs seq_len % (2*cp) == 0, got seq {seq_len}, cp {cp}"
@@ -37,7 +36,18 @@ def zigzag_order(seq_len: int, cp: int) -> np.ndarray:
         parts.append(np.arange(r * stripe, (r + 1) * stripe))
         parts.append(np.arange((2 * cp - 1 - r) * stripe,
                                (2 * cp - r) * stripe))
-    return np.concatenate(parts)
+    out = np.concatenate(parts)
+    out.setflags(write=False)  # cached and shared: callers must not mutate
+    return out
+
+
+def zigzag_order(seq_len: int, cp: int) -> np.ndarray:
+    """new_index -> old_index map: position i of the permuted sequence
+    holds original token order[i]. Rank r's contiguous slice of the
+    permuted sequence is [stripe_r, stripe_{2cp-1-r}]. Memoized (the
+    trainer permutes every step batch on the host hot path); the returned
+    array is read-only."""
+    return _order_cached(seq_len, cp)
 
 
 def zigzag_restore(seq_len: int, cp: int) -> np.ndarray:

@@ -204,6 +204,43 @@ class TestZigzagRingAttention:
                 mesh=mm.mesh, in_specs=(QKV_SPEC,) * 3, out_specs=QKV_SPEC,
             )(q, k, v)
 
+    def test_contiguous_trainer_unaffected_by_zigzag_env(self, monkeypatch):
+        """The layout must be pinned into each step from ITS config at
+        build time: a contiguous Trainer constructed before a zigzag one
+        (whose __init__ flips the process-global env var) but traced
+        after it must still run the contiguous schedule."""
+        import os
+
+        from scaletorch_tpu.benchmark import make_bench_args
+        from scaletorch_tpu.trainer.trainer import Trainer
+
+        monkeypatch.setenv("SCALETORCH_TPU_CP_LAYOUT", "contiguous")
+        contig = Trainer(make_bench_args(
+            "dense-tiny", seq=64, dtype="float32", dp=4, cp=2, micro_bs=2,
+            extra={"cp_layout": "contiguous"}))
+        zz = Trainer(make_bench_args(
+            "dense-tiny", seq=64, dtype="float32", dp=4, cp=2, micro_bs=2))
+        zz.close()
+        assert os.environ["SCALETORCH_TPU_CP_LAYOUT"] == "zigzag"
+        ref = Trainer(make_bench_args(
+            "dense-tiny", seq=64, dtype="float32", dp=8, micro_bs=1))
+        try:
+            losses = {}
+            for name, t in {"dp8": ref, "contig": contig}.items():
+                it = iter(t.loader)
+                for _ in range(2):
+                    batch = t._device_batch(next(it))
+                    t.params, t.opt_state, m = t.step_fn(
+                        t.params, t.opt_state, batch)
+                losses[name] = float(m["loss"])
+        finally:
+            contig.close()
+            ref.close()
+        # contig's step first traced AFTER the env flipped to zigzag; a
+        # trace-time env read would run the zigzag schedule on contiguous
+        # shards and corrupt the loss
+        assert losses["contig"] == pytest.approx(losses["dp8"], rel=2e-4)
+
     def test_trainer_zigzag_matches_dp_only_loss(self, monkeypatch):
         """End-to-end: a cp=2 zigzag Trainer (env toggle + host batch
         permutation + ring schedule) reproduces the dp-only loss — the

@@ -36,3 +36,33 @@ def test_profile_flops_breakdown_matches_mfu_formula():
     # (utils/misc.get_mfu): 3x the forward 4*L*heads*hd*seq
     assert 3 * br["attention"] == 12 * p["num_hidden_layers"] * \
         p["num_attention_heads"] * p["head_dim"] * seq
+
+
+def test_group_hosts_slice_major_ranks():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "group_hosts", os.path.join(REPO, "scripts", "group_hosts.py"))
+    gh = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gh)
+
+    lines = [
+        "t1v-n-abc-w-0",          # slice t1v-n-abc
+        "10.0.0.1 rack-b",        # explicit rack column
+        "t1v-n-abc-w-1",
+        "10.0.0.2 rack-b",
+        "bare-host",              # its own group
+    ]
+    groups = gh.group_hosts(lines)
+    assert groups["t1v-n-abc"] == ["t1v-n-abc-w-0", "t1v-n-abc-w-1"]
+    assert groups["rack-b"] == ["10.0.0.1", "10.0.0.2"]
+    assert groups["bare-host"] == ["bare-host"]
+    # slice-major contiguous ranks: same slice -> adjacent process indices
+    ranks = gh.rank_assignment(groups)
+    by_key = {}
+    for rank, _, key in ranks:
+        by_key.setdefault(key, []).append(rank)
+    for key, rs in by_key.items():
+        assert rs == list(range(rs[0], rs[0] + len(rs))), (key, rs)
+    # rendered output round-trips through the grouped-file parser
+    assert gh.group_hosts(gh.render(groups).splitlines()) == groups

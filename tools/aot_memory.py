@@ -94,6 +94,16 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
     is_moe = cfg.model_type == "qwen3_moe"
     mod = qwen3_moe if is_moe else llama
     params = jax.eval_shape(lambda: mod.init_params(jax.random.key(0), model_cfg))
+    if pp > 1 and model_cfg.num_hidden_layers % pp:
+        # Mirror the Trainer's uneven-PP padding so the HBM estimate
+        # covers the padded slots the real run carries.
+        from scaletorch_tpu.parallel.pipeline_parallel import pad_stacked_params
+
+        params = dict(params, layers=jax.eval_shape(
+            lambda t: pad_stacked_params(
+                t, model_cfg.num_hidden_layers, pp),
+            params["layers"],
+        ))
     moe_specs = (qwen3_moe.qwen3_moe_param_specs(
         model_cfg, tp_axis="tp",
         ep_axis="ep" if ep > 1 else None,

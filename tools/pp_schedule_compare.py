@@ -48,6 +48,9 @@ def main() -> None:
     ap.add_argument("--native", action="store_true",
                     help="use whatever devices jax sees (default: force a "
                          "pp*dp virtual CPU mesh)")
+    ap.add_argument("--out", default=None,
+                    help="also write the result JSON to this path "
+                         "(committed evidence artifact)")
     args = ap.parse_args()
 
     if not args.native:
@@ -98,6 +101,9 @@ def main() -> None:
         ),
     }
     print(json.dumps(out, indent=1))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
 
 
 if __name__ == "__main__":
