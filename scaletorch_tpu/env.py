@@ -66,6 +66,11 @@ register_env("SCALETORCH_TPU_CP_LAYOUT", "contiguous", str)
 # Sequence-chunk length for the fused LM-head + cross-entropy (bounds the
 # live fp32 [B, C, V/tp] logits transient; halve on HBM-edge configs).
 register_env("SCALETORCH_TPU_CE_CHUNK", "1024", int)
+# Grouped-MLP Pallas kernel for MoE expert compute (ops/pallas/
+# grouped_mlp.py): skips capacity slots past each expert's fill count.
+# Default OFF until measured faster than the batched einsum on real
+# chips (the einsum is already MXU-dense; the win is the padding skip).
+register_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "0", _as_bool)
 # Flash-kernel tile sizes (ops/pallas/flash.py). The defaults are sound
 # for d=64..128 on v5e VMEM; tools/optimize_mfu.py --flash-blocks sweeps
 # these on the actual chip (block choice is a measured property, not a

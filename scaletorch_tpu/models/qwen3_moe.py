@@ -170,6 +170,22 @@ def moe_block(
     )(logits)
     aux = {k: jnp.mean(v, axis=0) for k, v in aux.items()}  # mean over groups
     slots = dispatch_tokens(h_full, dispatch, axis=ep_axis)
+    kernel_extra = {}
+    from scaletorch_tpu.env import get_env
+
+    if get_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL"):
+        # slot-skipping expert kernel: per-(expert, group) fill counts
+        # ride the same exchange layout as the slots
+        from scaletorch_tpu.ops.pallas.grouped_mlp import slot_fill_counts
+        from scaletorch_tpu.parallel.expert_parallel import (
+            exchange_slot_counts,
+        )
+
+        kernel_extra = dict(
+            slot_counts=exchange_slot_counts(
+                slot_fill_counts(dispatch), ep_axis),
+            capacity=cap,
+        )
     out = moe_mlp(
         slots,
         layer["expert_gate_proj"],
@@ -178,6 +194,7 @@ def moe_block(
         tp_axis=tp_axis,
         compute_dtype=cfg.dtype,
         reduce="none" if sequence_parallel else "sum",
+        **kernel_extra,
     )
     y = gather_tokens(out, combine, axis=ep_axis)  # [B, S, H]
     if sequence_parallel:

@@ -93,10 +93,17 @@ def top_k_routing(
     if normalize_weights:
         gate_w = gate_w / jnp.sum(gate_w, axis=-1, keepdims=True)
 
+    # Explicit iota==index one-hots instead of jax.nn.one_hot: the latter
+    # lowers through a closed_call whose MLIR lowering-cache entry goes
+    # missing when an interpret-mode pallas_call is lowered in the same
+    # program (the grouped-MLP kernel tests on CPU).
+    def onehot_f(idx, depth):
+        return (idx[..., None] == jnp.arange(depth)).astype(jnp.float32)
+
     # Position of each (token, choice) in its expert's queue: tokens are
     # served in index order, choice-major (k-th choices queue after all
     # (k-1)-th choices of earlier tokens — the Switch convention).
-    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)  # [N, k, E]
+    onehot = onehot_f(gate_idx, e).astype(jnp.int32)  # [N, k, E]
     # flatten choices to [k*N, E] in choice-major order so cumsum ranks
     # first choices of all tokens before any second choice.
     flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
@@ -107,18 +114,16 @@ def top_k_routing(
 
     # dispatch/combine tensors
     dispatch = (
-        jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)[..., None]
-        * jax.nn.one_hot(jnp.where(kept, pos, capacity), capacity,
-                         dtype=jnp.float32)[:, :, None, :]
+        onehot_f(gate_idx, e)[..., None]
+        * onehot_f(jnp.where(kept, pos, capacity), capacity)[:, :, None, :]
     )  # [N, k, E, C]
     dispatch = jnp.sum(dispatch, axis=1)  # [N, E, C]
     combine = (
-        jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+        onehot_f(gate_idx, e)
         * jnp.where(kept, gate_w, 0.0)[..., None]
     )  # [N, k, E]
     combine = jnp.einsum("nke,nkc->nec", combine,
-                         jax.nn.one_hot(jnp.where(kept, pos, capacity),
-                                        capacity, dtype=jnp.float32))
+                         onehot_f(jnp.where(kept, pos, capacity), capacity))
 
     # Switch aux loss: E * sum_e f_e * P_e (pre-capacity assignment counts)
     f = jnp.mean(jnp.sum(onehot.astype(jnp.float32), axis=1), axis=0)  # [E]
@@ -400,6 +405,8 @@ def moe_mlp(
     tp_axis: Optional[str] = None,
     compute_dtype: Any = None,
     reduce: str = "sum",
+    slot_counts: Optional[jax.Array] = None,
+    capacity: Optional[int] = None,
 ) -> jax.Array:
     """Batched per-expert SwiGLU: the grouped-matmul role of
     npu_grouped_matmul (reference models/npu_patch.py:94-131) as a single
@@ -411,6 +418,11 @@ def moe_mlp(
     reference's EP×TP composition, model_qwen3_moe.py:192-207);
     ``reduce='none'`` skips the completing psum so the caller can fuse it
     into a sequence reduce-scatter (the SP exit path).
+
+    With ``slot_counts`` [E_local, T/capacity] + ``capacity`` AND the
+    ``SCALETORCH_TPU_GROUPED_MLP_KERNEL`` env toggle, the compute runs
+    the slot-skipping Pallas kernel (ops/pallas/grouped_mlp.py) instead —
+    empty capacity slots past each block's fill count cost nothing.
     """
     cdt = compute_dtype or x_grouped.dtype
     gate_w, up_w, down_w = (w.astype(cdt) for w in (gate_w, up_w, down_w))
@@ -419,11 +431,45 @@ def moe_mlp(
         up_w = pvary_missing(up_w, tp_axis)
         down_w = pvary_missing(down_w, tp_axis)
         x_grouped = pvary_missing(x_grouped, tp_axis)
-    from scaletorch_tpu.models.layers import swiglu
+    from scaletorch_tpu.env import get_env
 
-    g = jnp.einsum("eth,ehi->eti", x_grouped, gate_w)
-    u = jnp.einsum("eth,ehi->eti", x_grouped, up_w)
-    out = jnp.einsum("eti,eih->eth", swiglu(g, u), down_w)
+    if (slot_counts is not None and capacity
+            and get_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL")):
+        from scaletorch_tpu.ops.flash_attention import _pallas_available
+        from scaletorch_tpu.ops.pallas.grouped_mlp import (
+            grouped_swiglu_mlp,
+            masked_grouped_mlp,
+        )
+
+        e_l, t, hd = x_grouped.shape
+        x4 = x_grouped.reshape(e_l, t // capacity, capacity, hd).astype(cdt)
+        if _pallas_available():
+            # custom_vjp: trailing config args are positional (nondiff)
+            out = grouped_swiglu_mlp(x4, slot_counts, gate_w, up_w, down_w)
+        else:
+            # off-TPU: identical masked semantics, no pallas lowering
+            out = masked_grouped_mlp(x4, slot_counts, gate_w, up_w, down_w)
+        out = out.reshape(e_l, t, hd)
+    else:
+        from scaletorch_tpu.models.layers import swiglu
+
+        g = jnp.einsum("eth,ehi->eti", x_grouped, gate_w)
+        u = jnp.einsum("eth,ehi->eti", x_grouped, up_w)
+        out = jnp.einsum("eti,eih->eth", swiglu(g, u), down_w)
     if tp_axis is not None and reduce == "sum":
         out = jax.lax.psum(out, tp_axis)
     return out
+
+
+def exchange_slot_counts(counts: jax.Array, axis: Optional[str]) -> jax.Array:
+    """[E, G] per-(expert, group) fill counts -> this rank's receive-slab
+    order [E_local, ep·G], matching dispatch_tokens' token layout (blocks
+    of ``capacity`` ordered (source_rank, group))."""
+    if axis is None:
+        return counts
+    counts = pvary_missing(counts, axis)
+    ep = jax.lax.axis_size(axis)
+    e, g = counts.shape
+    c = counts.reshape(ep, e // ep, g)
+    c = jax.lax.all_to_all(c, axis, split_axis=0, concat_axis=0)
+    return c.transpose(1, 0, 2).reshape(e // ep, ep * g)

@@ -222,6 +222,10 @@ def run_config(cfg, steps, device, timeout):
                 "wall_s": wall,
             }
         metrics = load_perf_json(perf_dir, WARMUP_STEPS) or {}
+        if device == "cpu":
+            # the correctness tier runs on virtual CPU devices: an MFU
+            # against TPU peak FLOPS is physically meaningless there
+            metrics.pop("mfu", None)
         return {"label": label, "model": model, "status": "OK",
                 "world": nchips, "wall_s": wall, **metrics}
 

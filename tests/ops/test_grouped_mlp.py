@@ -1,0 +1,111 @@
+"""Grouped-MLP Pallas kernel: slot-skipping expert compute.
+
+The npu_grouped_matmul-role kernel (reference models/npu_patch.py:94-131)
+is validated in interpret mode against the masked dense reference, and
+end-to-end: a Qwen3-MoE forward with the kernel toggled on must produce
+bit-comparable outputs to the batched-einsum path — the kernel only
+skips slots that are zero anyway.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from scaletorch_tpu.ops.pallas.grouped_mlp import (
+    masked_grouped_mlp,
+    grouped_swiglu_mlp,
+    slot_fill_counts,
+)
+
+
+def _problem(seed=0, e=4, g=2, c=8, h=16, i=32):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((e, g, c, h)).astype(np.float32)
+    counts = rng.integers(0, c + 1, size=(e, g)).astype(np.int32)
+    wg = (rng.standard_normal((e, h, i)) * 0.1).astype(np.float32)
+    wu = (rng.standard_normal((e, h, i)) * 0.1).astype(np.float32)
+    wd = (rng.standard_normal((e, i, h)) * 0.1).astype(np.float32)
+    return tuple(map(jnp.asarray, (x, counts, wg, wu, wd)))
+
+
+class TestKernelParity:
+    def test_forward_matches_masked_dense(self):
+        x, counts, wg, wu, wd = _problem()
+        out = grouped_swiglu_mlp(x, counts, wg, wu, wd, 4, 16, True)
+        ref = masked_grouped_mlp(x, counts, wg, wu, wd)
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        # rows past the fill count are structurally zero
+        assert float(jnp.abs(out[0, 0, int(counts[0, 0]):]).max()) == 0.0
+
+    def test_vjp_matches_masked_dense(self):
+        x, counts, wg, wu, wd = _problem()
+
+        def loss(x, wg, wu, wd):
+            return jnp.sum(
+                grouped_swiglu_mlp(x, counts, wg, wu, wd, 4, 16, True) ** 2)
+
+        def loss_ref(x, wg, wu, wd):
+            return jnp.sum(masked_grouped_mlp(x, counts, wg, wu, wd) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_slot_fill_counts(self):
+        # [G, N, E, C] one-hots: fill counts are per-(e, g) occupancies
+        disp = np.zeros((2, 4, 3, 2), np.float32)
+        disp[0, 0, 1, 0] = 1
+        disp[0, 2, 1, 1] = 1
+        disp[1, 3, 2, 0] = 1
+        counts = slot_fill_counts(jnp.asarray(disp))
+        assert counts.shape == (3, 2)
+        assert counts[1, 0] == 2 and counts[2, 1] == 1 and counts[0, 0] == 0
+
+
+class TestMoEForwardToggle:
+    @pytest.mark.parametrize("ep", [1, 2])
+    def test_kernel_path_matches_einsum_path(self, monkeypatch, ep):
+        from scaletorch_tpu.models.qwen3_moe import (
+            Qwen3MoEConfig,
+            forward,
+            init_params,
+            qwen3_moe_param_specs,
+        )
+        from scaletorch_tpu.parallel.mesh import MeshManager
+
+        cfg = Qwen3MoEConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=48, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, head_dim=8,
+            num_experts=4, num_experts_per_tok=2, capacity_factor=1.25,
+            dtype=jnp.float32, qk_norm=True, tie_word_embeddings=False,
+        )
+        params = init_params(jax.random.PRNGKey(0), cfg)
+        ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
+
+        outs = {}
+        for mode in ("einsum", "kernel"):
+            monkeypatch.setenv("SCALETORCH_TPU_GROUPED_MLP_KERNEL",
+                               "1" if mode == "kernel" else "0")
+            if ep == 1:
+                outs[mode] = forward(params, ids, cfg)
+            else:
+                mm = MeshManager(ep=ep, dp=8 // ep)
+                specs = qwen3_moe_param_specs(cfg, tp_axis="tp", ep_axis="ep")
+
+                def f(p, i):
+                    out = forward(p, i, cfg, ep_axis="ep")
+                    # logits vary over (ep, tp) via the expert shards'
+                    # spec; collapse the identical copies
+                    return jax.lax.pmean(out, ("ep", "tp"))
+
+                outs[mode] = jax.shard_map(
+                    f, mesh=mm.mesh, in_specs=(specs, P()), out_specs=P(),
+                )(params, ids)
+        np.testing.assert_allclose(outs["kernel"], outs["einsum"],
+                                   atol=2e-5)

@@ -78,10 +78,14 @@ def fake_bench(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "CHILD_ARGV", [sys.executable, str(child)])
     monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("BENCH_SIGINT_WAITS", "1,1")
-    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "900")
+    # 399: phase 1+2 fit (each check needs >=360/180 remaining) but the
+    # phase-3 extra-rows loop (needs >=400) stays off unless a test
+    # raises the budget explicitly
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "399")
     monkeypatch.setenv("BENCH_ROW_BUDGET", "10")
     monkeypatch.setenv("BENCH_PREFLIGHT_BUDGET", "5")
     monkeypatch.setenv("BENCH_PALLAS_ROW_BUDGET", "5")
+    monkeypatch.setenv("BENCH_EXTRA_ROW_BUDGET", "10")
 
     def set_spec(**spec):
         monkeypatch.setenv("FAKE_SPEC", json.dumps(spec))
@@ -199,6 +203,53 @@ def test_table_mode_short_circuits_after_wedge(fake_bench, capsys, monkeypatch):
     assert all("skipped: chip wedged" in s for s in statuses[1:])
     line = _stdout_line(capsys)
     assert line["metric"] == "error"
+
+
+def test_extra_rows_fill_remaining_budget(fake_bench, capsys, monkeypatch):
+    """Phase 3: with budget left after the headline decision, extra table
+    rows are measured on the winning attention path and land in
+    bench_table.json — one driver invocation banks table evidence."""
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "100000")
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=52.0)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 52.0
+    table = json.loads(open("bench_table.json").read())
+    assert "qwen3-0.6b_seq16384_bs1_gc" in table  # the 56.0%-MFU target row
+    assert line["rows_measured"] == len(table)
+
+
+def test_extra_rows_stop_after_a_timeout(fake_bench, capsys, monkeypatch):
+    """A row that exceeds its budget ends phase 3 — the tail of the
+    window must not be burned on a sick chip — and the headline line
+    still prints."""
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "100000")
+    # pallas experiment off -> extra rows run on the sdpa path, which
+    # hangs for every row after the banked one ran fine... so make the
+    # banked row ok and poison only the extras via a one-shot flag file
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4, preflight="error",
+               pallas_row="ok", pallas_row_mfu=52.0)
+    # after the banked row, flip the spec so extra rows hang
+    real_run_child = bench._run_child
+    calls = []
+
+    def spying(env, budget, label):
+        if label not in ("sdpa_row", "pallas_preflight", "pallas_row"):
+            import os as _os
+
+            _os.environ["FAKE_SPEC"] = json.dumps({"sdpa_row": "hang"})
+        calls.append(label)
+        return real_run_child(env, budget, label)
+
+    monkeypatch.setattr(bench, "_run_child", spying)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["value"] == 45.4
+    # exactly one extra row attempted: it timed out and ended phase 3
+    extras = [c for c in calls
+              if c not in ("sdpa_row", "pallas_preflight", "pallas_row")]
+    assert len(extras) == 1
 
 
 def test_stale_child_mode_env_cannot_hijack_children(fake_bench, capsys,
