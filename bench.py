@@ -514,9 +514,12 @@ def run_headline() -> int:
             table[label] = res.payload
         else:
             table[label] = {"metric": label, "error": res.error}
-            if res.timed_out:
-                break  # do not spend the tail on a sick chip
         _dump_table(table)
+        if res.timed_out:
+            # even a late_exit row (payload printed, teardown overran)
+            # means every further row pays budget + stop ladder on a
+            # degraded chip — keep what we have and stop
+            break
     best["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     best["rows_measured"] = sum(1 for v in table.values() if "error" not in v)
     print(json.dumps(best))

@@ -431,10 +431,10 @@ def moe_mlp(
         up_w = pvary_missing(up_w, tp_axis)
         down_w = pvary_missing(down_w, tp_axis)
         x_grouped = pvary_missing(x_grouped, tp_axis)
-    from scaletorch_tpu.env import get_env
-
-    if (slot_counts is not None and capacity
-            and get_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL")):
+    # Passing slot_counts+capacity IS the opt-in (the env toggle gates
+    # the single production call site, qwen3_moe.moe_block); re-checking
+    # the env here would silently no-op explicit callers.
+    if slot_counts is not None and capacity:
         from scaletorch_tpu.ops.flash_attention import _pallas_available
         from scaletorch_tpu.ops.pallas.grouped_mlp import (
             grouped_swiglu_mlp,

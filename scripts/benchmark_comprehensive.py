@@ -144,8 +144,10 @@ def world_size(cfg) -> int:
     return tp * pp * dp * cp * ep
 
 
-def load_perf_json(perf_dir, warmup):
+def load_perf_json(perf_dir, warmup, include_mfu=True):
     """Read the trainer's dumped metrics history (MetricsLogger.save_json).
+    ``include_mfu=False`` for the CPU correctness tier, where utilization
+    against TPU peak FLOPS is physically meaningless.
 
     Files are named ``performance_log_proc{P}_step{S}.json``; pick process
     0's latest step deterministically — a lexicographic sort would grab an
@@ -170,8 +172,9 @@ def load_perf_json(perf_dir, warmup):
     out = {
         "loss": round(steady[-1]["loss"], 4),
         "tokens_per_sec": round(sum(r["tokens_per_second"] for r in steady) / n),
-        "mfu": round(sum(r.get("mfu", 0.0) for r in steady) / n, 2),
     }
+    if include_mfu:
+        out["mfu"] = round(sum(r.get("mfu", 0.0) for r in steady) / n, 2)
     mems = [r["peak_memory_gb"] for r in steady if "peak_memory_gb" in r]
     if mems:
         out["memory_gb"] = round(max(mems), 2)
@@ -221,11 +224,8 @@ def run_config(cfg, steps, device, timeout):
                 "error": msg[:300],
                 "wall_s": wall,
             }
-        metrics = load_perf_json(perf_dir, WARMUP_STEPS) or {}
-        if device == "cpu":
-            # the correctness tier runs on virtual CPU devices: an MFU
-            # against TPU peak FLOPS is physically meaningless there
-            metrics.pop("mfu", None)
+        metrics = load_perf_json(perf_dir, WARMUP_STEPS,
+                                 include_mfu=device != "cpu") or {}
         return {"label": label, "model": model, "status": "OK",
                 "world": nchips, "wall_s": wall, **metrics}
 
@@ -272,8 +272,8 @@ def main():
         r = run_config(cfg, args.steps, device, args.timeout)
         results.append(r)
         status = r["status"] if r["status"] != "OK" else (
-            f"OK loss={r.get('loss')} tok/s={r.get('tokens_per_sec')} "
-            f"mfu={r.get('mfu')}%")
+            f"OK loss={r.get('loss')} tok/s={r.get('tokens_per_sec')}"
+            + (f" mfu={r['mfu']}%" if "mfu" in r else ""))
         print(f"  -> {status} ({r['wall_s']}s)", flush=True)
         with open(args.out, "w") as f:  # incremental: survive any crash
             json.dump(results, f, indent=1)
