@@ -396,6 +396,23 @@ def _error_line(reason: str, **extra) -> None:
                       "vs_baseline": 0, "error": reason[:400], **extra}))
 
 
+def _tunnel_probe() -> bool | None:
+    """Is anything listening on the remote-execution relay's first port?
+    Diagnostic only (None when the env doesn't look like the tunnel
+    setup): a refused connect distinguishes 'relay process is gone'
+    from 'relay up but the far side is stuck'."""
+    import socket
+
+    host = os.environ.get("PALLAS_AXON_POOL_IPS", "")
+    if host != "127.0.0.1":
+        return None
+    try:
+        with socket.create_connection((host, 8082), timeout=2):
+            return True
+    except OSError:
+        return False
+
+
 def _dump_table(results: dict) -> None:
     with open("bench_table.json", "w") as f:
         json.dump(results, f, indent=1)
@@ -419,9 +436,11 @@ def run_headline() -> int:
         _dump_table({HEADLINE + "_sdpa": banked.payload})
     else:
         tunnel_dead = banked.timed_out and banked.stage in (None, "start")
+        probe = _tunnel_probe()
         _error_line(
             banked.error or "sdpa row produced nothing",
             wedge_stage=banked.stage,
+            **({"relay_listening": probe} if probe is not None else {}),
             **({"tunnel": "backend init never completed — axon relay "
                           "tunnel suspected dead"} if tunnel_dead else {}),
         )

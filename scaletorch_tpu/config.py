@@ -309,6 +309,9 @@ class ScaleTorchTPUArguments(
                 f"context_parallel_size {self.context_parallel_size}"
             )
         if (self.context_parallel_size > 1 and self.cp_layout == "zigzag"
+                # ulysses owns whole heads — the zigzag layout (and its
+                # stricter divisibility) never applies to it
+                and self.attention_backend != "ulysses"
                 and self.sequence_length % (2 * self.context_parallel_size)):
             raise ValueError(
                 f"cp_layout='zigzag' needs sequence_length "

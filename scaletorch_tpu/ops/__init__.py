@@ -8,3 +8,4 @@ model import (reference models/llama.py:38-57).
 from scaletorch_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from scaletorch_tpu.ops.pallas.grouped_mlp import grouped_swiglu_mlp  # noqa: F401
 from scaletorch_tpu.ops.ring_attention import ring_attention  # noqa: F401
+from scaletorch_tpu.ops.ulysses import ulysses_attention  # noqa: F401

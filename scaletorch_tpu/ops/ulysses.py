@@ -1,0 +1,111 @@
+"""Ulysses-style context parallelism — all-to-all head scatter.
+
+The reference ships only ring attention for CP (SURVEY.md §5 notes "no
+Ulysses (head-scatter all-to-all)"); on TPU the Ulysses layout (the
+DeepSpeed-Ulysses scheme) is a natural second strategy and often the
+better one at moderate sequence lengths:
+
+  * two ``lax.all_to_all``s swap the sharding axis — sequence-sharded
+    [B, H, S/cp, D] becomes head-sharded [B, H/cp, S, D] — and each rank
+    runs ONE full-sequence flash attention over its head subset;
+  * causal work is inherently balanced (every rank owns whole heads), so
+    no zigzag striping or per-step `lax.cond` schedule is needed;
+  * comm volume is 2 all-to-alls of the activations vs the ring's cp-1
+    K/V rotations — cheaper whenever 2·S·D < (cp-1)·2·S/cp·D·(Hkv/Hq)
+    ... in practice: fewer, larger transfers that XLA overlaps better;
+  * the trade-off is parallelism degree: cp must divide the KV head
+    count (GQA models cap cp at Hkv), where the ring scales cp
+    arbitrarily — the registry keeps 'ring' the CP default and 'ulysses'
+    an opt-in (``--attention_backend ulysses``).
+
+Differentiability is free: ``all_to_all`` transposes to itself and the
+inner attention is the already-VJP'd flash/SDPA path, so no custom VJP.
+
+Inputs are post-RoPE q/k/v sequence shards in the CONTIGUOUS layout
+(head ownership makes zigzag pointless; the Trainer skips the zigzag
+host permutation for this backend).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from scaletorch_tpu.models.registry import register_attention_backend
+from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+
+
+def _scatter_heads(x: jax.Array, axis: str) -> jax.Array:
+    """[B, H, S/cp, D] -> [B, H/cp, S, D]: split heads, gather sequence."""
+    return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def _gather_heads(x: jax.Array, axis: str) -> jax.Array:
+    """[B, H/cp, S, D] -> [B, H, S/cp, D]: the inverse exchange."""
+    return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    axis: str = "cp",
+    impl: Optional[str] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """q: [B, Hq, S/cp, D]; k/v: [B, Hkv, S/cp, D] local sequence shards
+    (contiguous layout). Requires Hq % cp == 0 and Hkv % cp == 0."""
+    cp = jax.lax.axis_size(axis)
+    hq, hkv = q.shape[1], k.shape[1]
+    if hq % cp or hkv % cp:
+        raise ValueError(
+            f"ulysses needs cp ({cp}) to divide both query heads ({hq}) and "
+            f"kv heads ({hkv}); use the 'ring' backend for higher cp"
+        )
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if cp == 1:
+        # degenerate: no exchange; still honour an explicit impl override
+        if impl == "xla":
+            from scaletorch_tpu.models.layers import sdpa_attention
+
+            return sdpa_attention(q, k, v, causal=causal, scale=scale)
+        if impl == "pallas":
+            from scaletorch_tpu.ops.pallas.flash import pallas_flash_attention
+
+            return pallas_flash_attention(q, k, v, causal=causal,
+                                          scale=scale, interpret=interpret)
+        from scaletorch_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+
+    q, k, v = (pvary_missing(t, axis) for t in (q, k, v))
+    qh = _scatter_heads(q, axis)   # [B, Hq/cp, S, D]
+    kh = _scatter_heads(k, axis)
+    vh = _scatter_heads(v, axis)
+
+    if impl is None:
+        from scaletorch_tpu.ops.flash_attention import _pallas_available
+
+        impl = "pallas" if _pallas_available() else "xla"
+    if impl == "pallas":
+        from scaletorch_tpu.ops.pallas.flash import pallas_flash_attention
+
+        o = pallas_flash_attention(qh, kh, vh, causal=causal, scale=scale,
+                                   interpret=interpret)
+    else:
+        from scaletorch_tpu.models.layers import sdpa_attention
+
+        o = sdpa_attention(qh, kh, vh, causal=causal, scale=scale)
+    return _gather_heads(pvary_missing(o, axis), axis)
+
+
+register_attention_backend("ulysses", ulysses_attention)

@@ -419,10 +419,11 @@ def moe_mlp(
     ``reduce='none'`` skips the completing psum so the caller can fuse it
     into a sequence reduce-scatter (the SP exit path).
 
-    With ``slot_counts`` [E_local, T/capacity] + ``capacity`` AND the
-    ``SCALETORCH_TPU_GROUPED_MLP_KERNEL`` env toggle, the compute runs
-    the slot-skipping Pallas kernel (ops/pallas/grouped_mlp.py) instead —
-    empty capacity slots past each block's fill count cost nothing.
+    Passing ``slot_counts`` [E_local, T/capacity] + ``capacity`` opts in
+    to the slot-skipping Pallas kernel (ops/pallas/grouped_mlp.py) —
+    empty capacity slots past each block's fill count cost nothing. The
+    ``SCALETORCH_TPU_GROUPED_MLP_KERNEL`` env toggle gates only the
+    production call site (qwen3_moe.moe_block).
     """
     cdt = compute_dtype or x_grouped.dtype
     gate_w, up_w, down_w = (w.astype(cdt) for w in (gate_w, up_w, down_w))

@@ -171,19 +171,31 @@ class Trainer:
         self.attention_backend = resolve_attention_backend(
             cfg.attention_backend, context_parallel=cfg.context_parallel_size > 1
         )
-        if cfg.context_parallel_size > 1 and self.attention_backend != "ring":
+        if (cfg.context_parallel_size > 1
+                and self.attention_backend not in ("ring", "ulysses")):
             # A full-sequence backend on cp-sharded activations would silently
             # compute block-diagonal attention.
             raise ValueError(
-                f"context_parallel_size={cfg.context_parallel_size} requires the "
-                f"'ring' attention backend, got {self.attention_backend!r}"
+                f"context_parallel_size={cfg.context_parallel_size} requires a "
+                f"CP-aware attention backend ('ring' or 'ulysses'), got "
+                f"{self.attention_backend!r}"
             )
         # CP sequence layout: the ring backend reads the env toggle at trace
         # time (model code calls backends without layout kwargs), and
         # _device_batch applies the matching host-side token permutation.
+        # Ulysses owns whole heads, so its causal work is balanced in the
+        # contiguous layout already — no permutation.
         self._zigzag_cp = (
             cfg.context_parallel_size > 1 and cfg.cp_layout == "zigzag"
+            and self.attention_backend == "ring"
         )
+        if (cfg.context_parallel_size > 1 and cfg.cp_layout == "zigzag"
+                and self.attention_backend == "ulysses"):
+            self.logger.info(
+                "cp_layout='zigzag' has no effect with the ulysses backend "
+                "(head ownership balances causal work); using the "
+                "contiguous sequence layout"
+            )
         os.environ["SCALETORCH_TPU_CP_LAYOUT"] = (
             "zigzag" if self._zigzag_cp else "contiguous"
         )
