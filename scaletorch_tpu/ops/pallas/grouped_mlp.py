@@ -16,9 +16,13 @@ capacity factor c and balanced routing ~1 - 1/c of slot FLOPs are
 padding (20% at c=1.25); under imbalance the skip grows to whatever the
 cold experts leave empty.
 
-Forward-only by design: the VJP recomputes through the masked XLA path
-(the backward's matmuls run dense — a backward kernel is a follow-up).
-Numerics: fp32 accumulation over intermediate tiles, bf16 MXU feeds.
+The backward is two kernels with the same slot skip — a dx kernel
+(reduction over I innermost) and a dW kernel (reduction over (group,
+slot-tile) innermost), mirroring flash attention's dq/dkv split: every
+output's reduction axes must be the innermost grid dims so its scratch
+accumulator survives the sweep. Numerics: fp32 accumulation, bf16 MXU
+feeds; ``masked_grouped_mlp`` is the dense XLA reference (and the
+off-TPU execution path).
 """
 
 from __future__ import annotations
@@ -86,6 +90,158 @@ def _kernel(count_ref, x_ref, wg_ref, wu_ref, wd_ref, o_ref, acc_sc,
         o_ref[0, 0] = jnp.where(row < count, acc_sc[:], 0.0).astype(o_ref.dtype)
 
 
+def _block_grads(x, wg, wu, wd, do):
+    """Shared per-tile backward math: recompute gate/up/silu in fp32 and
+    return (s, dg, du) for the dx and dW kernels.
+
+    s  = silu(g)·u (the down-projection input)
+    dS = dO · Wd^T;  du = dS·silu(g);  dg = dS·u·silu'(g)
+    with silu'(g) = σ(g)·(1 + g·(1 − σ(g))).
+    """
+    g = jax.lax.dot_general(
+        x, wg, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    u = jax.lax.dot_general(
+        x, wu, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    sig = jax.nn.sigmoid(g)
+    silu = g * sig
+    s = silu * u
+    ds = jax.lax.dot_general(
+        do, wd, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    du = ds * silu
+    dg = ds * u * (sig * (1.0 + g * (1.0 - sig)))
+    return s, dg, du
+
+
+def _dx_kernel(count_ref, x_ref, wg_ref, wu_ref, wd_ref, do_ref, dx_ref,
+               acc_sc, *, bc, bi, ni):
+    c_t = pl.program_id(2)
+    i_t = pl.program_id(3)
+
+    @pl.when(i_t == 0)
+    def _init():
+        acc_sc[:] = jnp.zeros_like(acc_sc)
+
+    count = count_ref[0, 0, 0, 0]
+
+    @pl.when(c_t * bc < count)
+    def _block():
+        x = x_ref[0, 0]
+        _, dg, du = _block_grads(x, wg_ref[0], wu_ref[0], wd_ref[0],
+                                 do_ref[0, 0])
+        acc_sc[:] += jax.lax.dot_general(
+            dg.astype(x.dtype), wg_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_sc[:] += jax.lax.dot_general(
+            du.astype(x.dtype), wu_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i_t == ni - 1)
+    def _finalize():
+        row = c_t * bc + jax.lax.broadcasted_iota(jnp.int32, acc_sc.shape, 0)
+        dx_ref[0, 0] = jnp.where(row < count, acc_sc[:], 0.0).astype(
+            dx_ref.dtype)
+
+
+def _dw_kernel(counts_ref, x_ref, wg_ref, wu_ref, wd_ref, do_ref,
+               dwg_ref, dwu_ref, dwd_ref, dwg_sc, dwu_sc, dwd_sc,
+               *, bc, bi, ng, nc):
+    g_t = pl.program_id(2)
+    c_t = pl.program_id(3)
+
+    @pl.when((g_t == 0) & (c_t == 0))
+    def _init():
+        dwg_sc[:] = jnp.zeros_like(dwg_sc)
+        dwu_sc[:] = jnp.zeros_like(dwu_sc)
+        dwd_sc[:] = jnp.zeros_like(dwd_sc)
+
+    count = counts_ref[0, 0, 0, 0]
+
+    @pl.when(c_t * bc < count)
+    def _block():
+        x = x_ref[0, 0]
+        do = do_ref[0, 0]
+        # mask the covering tile's rows past the fill count: upstream
+        # cotangents of structurally-zero outputs must not train weights
+        # (parity with masked_grouped_mlp's where-mask VJP)
+        row = c_t * bc + jax.lax.broadcasted_iota(jnp.int32, do.shape, 0)
+        do = jnp.where(row < count, do, 0.0)
+        s, dg, du = _block_grads(x, wg_ref[0], wu_ref[0], wd_ref[0], do)
+        dwg_sc[:] += jax.lax.dot_general(
+            x, dg.astype(x.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwu_sc[:] += jax.lax.dot_general(
+            x, du.astype(x.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dwd_sc[:] += jax.lax.dot_general(
+            s.astype(x.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when((g_t == ng - 1) & (c_t == nc - 1))
+    def _finalize():
+        dwg_ref[0] = dwg_sc[:].astype(dwg_ref.dtype)
+        dwu_ref[0] = dwu_sc[:].astype(dwu_ref.dtype)
+        dwd_ref[0] = dwd_sc[:].astype(dwd_ref.dtype)
+
+
+def _backward(x, counts, wg, wu, wd, do, bc, bi, interpret):
+    """Slot-skipping backward: a dx kernel (reduction over I innermost)
+    and a dW kernel (reduction over (group, slot-tile) innermost) — the
+    same two-kernel split flash attention's backward uses, because each
+    output's reduction axes must be the innermost grid dims."""
+    e, g, c, h = x.shape
+    i_dim = wg.shape[-1]
+    nc, ni = c // bc, i_dim // bi
+    counts4 = counts.reshape(e, g, 1, 1)
+
+    dx = pl.pallas_call(
+        functools.partial(_dx_kernel, bc=bc, bi=bi, ni=ni),
+        grid=(e, g, nc, ni),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, 1), lambda e_, g_, c_, i_: (e_, g_, 0, 0)),
+            pl.BlockSpec((1, 1, bc, h), lambda e_, g_, c_, i_: (e_, g_, c_, 0)),
+            pl.BlockSpec((1, h, bi), lambda e_, g_, c_, i_: (e_, 0, i_)),
+            pl.BlockSpec((1, h, bi), lambda e_, g_, c_, i_: (e_, 0, i_)),
+            pl.BlockSpec((1, bi, h), lambda e_, g_, c_, i_: (e_, i_, 0)),
+            pl.BlockSpec((1, 1, bc, h), lambda e_, g_, c_, i_: (e_, g_, c_, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bc, h),
+                               lambda e_, g_, c_, i_: (e_, g_, c_, 0)),
+        out_shape=_struct((e, g, c, h), x.dtype, x),
+        scratch_shapes=[pltpu.VMEM((bc, h), jnp.float32)],
+        interpret=interpret,
+    )(counts4, x, wg, wu, wd, do)
+
+    dwg, dwu, dwd = pl.pallas_call(
+        functools.partial(_dw_kernel, bc=bc, bi=bi, ng=g, nc=nc),
+        grid=(e, i_dim // bi, g, nc),
+        in_specs=[
+            pl.BlockSpec((1, 1, 1, 1), lambda e_, i_, g_, c_: (e_, g_, 0, 0)),
+            pl.BlockSpec((1, 1, bc, h), lambda e_, i_, g_, c_: (e_, g_, c_, 0)),
+            pl.BlockSpec((1, h, bi), lambda e_, i_, g_, c_: (e_, 0, i_)),
+            pl.BlockSpec((1, h, bi), lambda e_, i_, g_, c_: (e_, 0, i_)),
+            pl.BlockSpec((1, bi, h), lambda e_, i_, g_, c_: (e_, i_, 0)),
+            pl.BlockSpec((1, 1, bc, h), lambda e_, i_, g_, c_: (e_, g_, c_, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, h, bi), lambda e_, i_, g_, c_: (e_, 0, i_)),
+            pl.BlockSpec((1, h, bi), lambda e_, i_, g_, c_: (e_, 0, i_)),
+            pl.BlockSpec((1, bi, h), lambda e_, i_, g_, c_: (e_, i_, 0)),
+        ],
+        out_shape=[
+            _struct(wg.shape, wg.dtype, wg),
+            _struct(wu.shape, wu.dtype, wu),
+            _struct(wd.shape, wd.dtype, wd),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((h, bi), jnp.float32),
+            pltpu.VMEM((h, bi), jnp.float32),
+            pltpu.VMEM((bi, h), jnp.float32),
+        ],
+        interpret=interpret,
+    )(counts4, x, wg, wu, wd, do)
+    return dx, dwg, dwu, dwd
+
+
 def _forward(x, counts, wg, wu, wd, bc, bi, interpret):
     e, g, c, h = x.shape
     i_dim = wg.shape[-1]
@@ -144,13 +300,10 @@ def _fwd(x, counts, wg, wu, wd, bc, bi, interpret):
 
 def _bwd(bc, bi, interpret, res, g_out):
     x, counts, wg, wu, wd = res
-    # Dense masked-XLA backward (kernel is forward-only for now): grads
-    # of padded rows vanish through the mask, matching the kernel output.
-    _, vjp = jax.vjp(
-        lambda x_, wg_, wu_, wd_: masked_grouped_mlp(x_, counts, wg_, wu_, wd_),
-        x, wg, wu, wd,
-    )
-    dx, dwg, dwu, dwd = vjp(g_out)
+    bc = _pick_block(x.shape[2], bc)
+    bi = _pick_block(wg.shape[-1], bi)
+    dx, dwg, dwu, dwd = _backward(x, counts, wg, wu, wd, g_out, bc, bi,
+                                  interpret)
     return dx, None, dwg, dwu, dwd
 
 

@@ -56,6 +56,27 @@ class TestKernelParity:
         for a, b in zip(g, gr):
             np.testing.assert_allclose(a, b, atol=1e-5)
 
+    def test_vjp_masks_cotangents_past_fill_count(self):
+        """The ``loss = sum(out**2)`` probe above has zero cotangent on
+        padded rows by construction; feed a DENSE random cotangent so the
+        dW kernel's do-masking is actually exercised — upstream gradients
+        of structurally-zero outputs must not train the weights."""
+        x, counts, wg, wu, wd = _problem(seed=3)
+        do = jnp.asarray(
+            np.random.default_rng(9).standard_normal(x.shape).astype(np.float32))
+
+        def loss(x, wg, wu, wd):
+            return jnp.sum(
+                grouped_swiglu_mlp(x, counts, wg, wu, wd, 4, 16, True) * do)
+
+        def loss_ref(x, wg, wu, wd):
+            return jnp.sum(masked_grouped_mlp(x, counts, wg, wu, wd) * do)
+
+        g = jax.grad(loss, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, wg, wu, wd)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
     def test_slot_fill_counts(self):
         # [G, N, E, C] one-hots: fill counts are per-(e, g) occupancies
         disp = np.zeros((2, 4, 3, 2), np.float32)

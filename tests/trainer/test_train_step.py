@@ -129,7 +129,6 @@ def test_uneven_pp_checkpoint_resume(tmp_path):
     where the continuous run would be."""
     import jax
 
-    from scaletorch_tpu.config import ScaleTorchTPUArguments
     from scaletorch_tpu.trainer.trainer import Trainer
 
     def cfg(**kw):
@@ -144,28 +143,20 @@ def test_uneven_pp_checkpoint_resume(tmp_path):
             log_frequency=100, checkpoint_dir=str(tmp_path), **kw,
         )
 
-    # continuous 4-step run = ground truth
-    t = Trainer(cfg())
-    it = iter(t.loader)
-    losses = []
-    for _ in range(4):
-        b = t._device_batch(next(it))
-        t.params, t.opt_state, m = t.step_fn(t.params, t.opt_state, b)
-        t.global_step += 1
-        losses.append(float(m["loss"]))
-    t.close()
-
-    # run 2 steps, save, resume in a fresh Trainer, run 2 more
+    # run 2 steps, SAVE mid-run, keep going to 4 — the continued half
+    # doubles as the ground truth (saving perturbs no training state)
     t1 = Trainer(cfg())
     it = iter(t1.loader)
-    for _ in range(2):
+    losses = []
+    for step in range(4):
         b = t1._device_batch(next(it))
         t1.params, t1.opt_state, m = t1.step_fn(t1.params, t1.opt_state, b)
         t1.global_step += 1
-    t1.tokens_seen = t1.global_step * t1.loader.tokens_per_step
-    t1.save_checkpoint()
-    if t1._ckpt_mgr is not None:
-        t1._ckpt_mgr.wait()
+        losses.append(float(m["loss"]))
+        if step == 1:
+            t1.tokens_seen = t1.global_step * t1.loader.tokens_per_step
+            t1.save_checkpoint()
+    t1._ckpt_mgr.wait()
     t1.close()
 
     t2 = Trainer(cfg(resume_from_checkpoint=True))
