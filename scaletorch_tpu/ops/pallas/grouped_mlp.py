@@ -278,9 +278,10 @@ def grouped_swiglu_mlp(x, counts, wg, wu, wd, bc=256, bi=512,
 
 
 def masked_grouped_mlp(x, counts, wg, wu, wd):
-    """Reference semantics for the VJP recompute AND the non-TPU
-    execution path: dense einsum with the past-count rows structurally
-    zeroed (exactly the kernel's output). Interpret-mode pallas inside a
+    """The dense numeric reference AND the non-TPU execution path:
+    einsum with the past-count rows structurally zeroed (exactly the
+    kernel's output; its autodiff is what the Pallas backward kernels
+    are parity-tested against). Interpret-mode pallas inside a
     large sharded program trips a JAX closed_call lowering-cache bug, so
     off-TPU callers take this path while the kernel itself is validated
     by interpret-mode parity tests and Mosaic AOT compilation."""

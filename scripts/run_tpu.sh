@@ -46,6 +46,10 @@ esac
 
 DP_SIZE=${DP_SIZE:-$NUM_CHIPS}; TP_SIZE=${TP_SIZE:-1}
 PP_SIZE=${PP_SIZE:-1}; CP_SIZE=${CP_SIZE:-1}
+# CP knobs: CP_LAYOUT=zigzag|contiguous (ring layout; zigzag balances the
+# causal ring), ATTN_BACKEND=auto|ring|ulysses (ulysses = all-to-all
+# head-scatter; cp must divide kv heads)
+CP_LAYOUT=${CP_LAYOUT:-zigzag}; ATTN_BACKEND=${ATTN_BACKEND:-auto}
 GLOBAL_TOK=$((MICRO_BS * SEQ_LEN * GRAD_ACCUM * DP_SIZE))
 
 echo "============================================"
@@ -78,6 +82,8 @@ exec python train.py \
     --pipeline_parallel_size ${PP_SIZE} \
     --data_parallel_size ${DP_SIZE} \
     --context_parallel_size ${CP_SIZE} \
+    --cp_layout ${CP_LAYOUT} \
+    --attention_backend ${ATTN_BACKEND} \
     --micro_batch_size ${MICRO_BS} \
     --gradient_accumulation_steps ${GRAD_ACCUM} \
     --sequence_length ${SEQ_LEN} \

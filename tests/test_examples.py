@@ -114,3 +114,22 @@ def test_trainer_points_examples_models_at_their_mains():
     cfg = ScaleTorchTPUArguments(model_type="gpt_moe")
     with pytest.raises(ValueError, match="examples/mingpt"):
         build_model_config(cfg)
+
+
+def test_longctx_example_both_strategies(capsys):
+    """CP demo: the loss decreases under both distributed-attention
+    strategies and the two agree at the same seed/geometry (both compute
+    exact full attention)."""
+    from examples.longctx.train_longctx import main
+
+    last = {}
+    for strategy in ("ring", "ulysses"):
+        last[strategy] = main([
+            "--cp", "2", "--seq", "256", "--steps", "6",
+            "--strategy", strategy,
+        ])
+        out = capsys.readouterr().out
+        assert f"strategy={strategy}" in out
+        first = float(out.split("loss ")[1].split(" ->")[0])
+        assert last[strategy] < first  # it actually learns
+    assert last["ring"] == pytest.approx(last["ulysses"], rel=2e-4)

@@ -155,7 +155,15 @@ def test_uneven_pp_checkpoint_resume(tmp_path):
         losses.append(float(m["loss"]))
         if step == 1:
             t1.tokens_seen = t1.global_step * t1.loader.tokens_per_step
+            fp_before = [float(jnp.sum(x)) for x in
+                         jax.tree_util.tree_leaves(t1.params)]
             t1.save_checkpoint()
+            t1._ckpt_mgr.wait()
+            # the continued half doubles as the ground truth ONLY if the
+            # save left training state untouched — assert it, don't assume
+            fp_after = [float(jnp.sum(x)) for x in
+                        jax.tree_util.tree_leaves(t1.params)]
+            assert fp_before == fp_after
     t1._ckpt_mgr.wait()
     t1.close()
 
