@@ -56,13 +56,17 @@ def main(argv=None) -> float:
     dp = args.dp or max(n_dev // args.cp, 1)
     cfg = ScaleTorchTPUArguments(
         model_type="llama", hidden_size=64, intermediate_size=128,
-        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        # 4 KV heads so the default --cp 4 works for ulysses too
+        # (cp must divide the KV head count for the head-scatter path)
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
         vocab_size=256, sequence_length=args.seq,
         max_position_embeddings=2 * args.seq,
         context_parallel_size=args.cp, data_parallel_size=dp,
         cp_layout=args.layout,
         attention_backend=args.strategy,
-        micro_batch_size=dp, synthetic_data=True,
+        # per-rank batch of 1: per-chip work stays fixed as the mesh
+        # grows (micro_batch_size is PER dp rank; global = micro * dp)
+        micro_batch_size=1, synthetic_data=True,
         total_train_steps=args.steps, dtype="float32",
         donate_params=False, log_frequency=max(args.steps // 4, 1),
     )

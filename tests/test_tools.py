@@ -10,6 +10,8 @@ from __future__ import annotations
 import os
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -66,3 +68,32 @@ def test_group_hosts_slice_major_ranks():
         assert rs == list(range(rs[0], rs[0] + len(rs))), (key, rs)
     # rendered output round-trips through the grouped-file parser
     assert gh.group_hosts(gh.render(groups).splitlines()) == groups
+
+
+def test_bench_cp_compare_mechanics(tmp_path):
+    """All three CP strategies run at one geometry and produce the same
+    loss (exact attention each way); speedups are emitted. CPU-mesh
+    numbers attest mechanics only (documented in the tool)."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    out = tmp_path / "cp.json"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "tools", "bench_cp_compare.py"),
+         "--cpu", "--model", "dense-tiny", "--cp", "2", "--dp", "2",
+         "--seq", "256", "--steps", "2", "--warmup", "1",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    data = json.loads(out.read_text())
+    for s in ("ring_contiguous", "ring_zigzag", "ulysses"):
+        assert "error" not in data[s], data[s]
+    # exact attention under every strategy, to fp32 reduction-order noise
+    base = data["ring_contiguous"]["loss"]
+    assert data["ring_zigzag"]["loss"] == pytest.approx(base, rel=2e-4)
+    assert data["ulysses"]["loss"] == pytest.approx(base, rel=2e-4)
+    assert "ring_zigzag_speedup_vs_contiguous" in data
