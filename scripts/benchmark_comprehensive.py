@@ -55,9 +55,12 @@ CORRECTNESS_CONFIGS = [
     ("tiny-PP2-DP4",         "dense-tiny", 1, 2, 4, 1, 1, 2, 2, 256, False, False, "1f1b"),
     ("tiny-PP4-DP2-afab",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "afab"),
     ("tiny-PP4-DP2-1f1b",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "1f1b"),
-    # --- CP ---
+    # --- CP (ring runs the zigzag layout by default; ulysses = the
+    # all-to-all head-scatter strategy) ---
     ("tiny-CP2-DP4",         "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "1f1b"),
     ("tiny-CP4-DP2-GC",      "dense-tiny", 1, 1, 2, 4, 1, 1, 1, 1024, True, False, "1f1b"),
+    ("tiny-CP2-DP4-ulysses", "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "1f1b",
+     {"attention_backend": "ulysses"}),
     # --- SP ---
     ("tiny-SP-TP2-DP4",      "dense-tiny", 2, 1, 4, 1, 1, 2, 1, 256, False, True,  "1f1b"),
     # --- mixed dense ---
