@@ -61,11 +61,25 @@ def zigzag_restore(seq_len: int, cp: int) -> np.ndarray:
 
 def zigzag_batch(batch: Dict[str, np.ndarray], cp: int) -> Dict[str, np.ndarray]:
     """Permute every per-token field of a step batch along its sequence
-    (last) axis into zigzag order. Identity at cp == 1."""
+    (last) axis into zigzag order. Identity at cp == 1.
+
+    Every field must share one sequence length (anchored on ``input_ids``
+    when present): a non-per-token field whose last axis merely happens to
+    divide 2*cp would otherwise be permuted silently wrong.
+    """
     if cp == 1:
         return batch
+    anchor = batch.get("input_ids")
+    seq_len = (anchor.shape[-1] if anchor is not None
+               else next(iter(batch.values())).shape[-1])
+    order = zigzag_order(seq_len, cp)
     out = {}
     for name, arr in batch.items():
-        order = zigzag_order(arr.shape[-1], cp)
+        if arr.shape[-1] != seq_len:
+            raise ValueError(
+                f"zigzag_batch: field '{name}' has last axis {arr.shape[-1]}"
+                f" != sequence length {seq_len}; only per-token fields can"
+                " ride the zigzag permutation — drop or reshape it first"
+            )
         out[name] = np.ascontiguousarray(np.take(arr, order, axis=-1))
     return out

@@ -196,9 +196,12 @@ class Trainer:
                 "(head ownership balances causal work); using the "
                 "contiguous sequence layout"
             )
-        os.environ["SCALETORCH_TPU_CP_LAYOUT"] = (
-            "zigzag" if self._zigzag_cp else "contiguous"
-        )
+        # NOTE: no process-global SCALETORCH_TPU_CP_LAYOUT write here — the
+        # spmd step pins the layout at trace time via the ring_zigzag /
+        # ring_contiguous registry aliases (parallel/spmd.py), so a second
+        # Trainer in the same process can use the other layout safely. The
+        # env toggle remains only as the default for direct 'ring' backend
+        # calls outside a Trainer.
 
         from scaletorch_tpu.parallel.spmd import (
             batch_specs,

@@ -193,6 +193,11 @@ class TestZigzagRingAttention:
                 == batch["input_ids"]).all()
         # cp=1 is the identity (and no copy semantics surprises)
         assert zigzag_batch(batch, 1) is batch
+        # a non-per-token field must raise loudly, even when its last axis
+        # happens to divide 2*cp (ADVICE r3: silent wrong permutation)
+        bad = dict(batch, routing_bias=np.zeros((2, 16)))
+        with pytest.raises(ValueError, match="routing_bias"):
+            zigzag_batch(bad, 4)
 
     def test_odd_local_sequence_rejected(self):
         q, k, v = make_qkv(s=4)  # local seq 1 at cp=4
@@ -221,7 +226,10 @@ class TestZigzagRingAttention:
         zz = Trainer(make_bench_args(
             "dense-tiny", seq=64, dtype="float32", dp=4, cp=2, micro_bs=2))
         zz.close()
-        assert os.environ["SCALETORCH_TPU_CP_LAYOUT"] == "zigzag"
+        # ADVICE r3: the Trainer must NOT mutate the process-global layout
+        # env — the step pins its layout via the ring_zigzag/ring_contiguous
+        # registry aliases instead
+        assert os.environ["SCALETORCH_TPU_CP_LAYOUT"] == "contiguous"
         ref = Trainer(make_bench_args(
             "dense-tiny", seq=64, dtype="float32", dp=8, micro_bs=1))
         try:
@@ -242,13 +250,13 @@ class TestZigzagRingAttention:
         assert losses["contig"] == pytest.approx(losses["dp8"], rel=2e-4)
 
     def test_trainer_zigzag_matches_dp_only_loss(self, monkeypatch):
-        """End-to-end: a cp=2 zigzag Trainer (env toggle + host batch
-        permutation + ring schedule) reproduces the dp-only loss — the
-        per-token losses are a permutation, so the mean is identical."""
+        """End-to-end: a cp=2 zigzag Trainer (pinned backend alias + host
+        batch permutation + ring schedule) reproduces the dp-only loss —
+        the per-token losses are a permutation, so the mean is identical."""
         from scaletorch_tpu.benchmark import make_bench_args
         from scaletorch_tpu.trainer.trainer import Trainer
 
-        # Trainer writes the layout env toggle; scope it to this test
+        # prove the pinned alias wins even against a contrary env default
         monkeypatch.setenv("SCALETORCH_TPU_CP_LAYOUT", "contiguous")
 
         losses = {}
