@@ -309,7 +309,14 @@ def sort_dispatch_tokens(
     ).reshape(-1)
     recv_local = jnp.where(
         recv_valid, recv_ids.reshape(-1) - me * e_local, e_local)
-    meta = {"order": order, "dest_s": dest_s, "slot": slot, "n": n, "p": p}
+    meta = {
+        "order": order, "dest_s": dest_s, "slot": slot, "n": n, "p": p,
+        # send-side rows past a destination slab (only when chunk_capacity
+        # undercuts a skewed send size) — 0 on the default zero-drop
+        # capacity; surfaces skew-induced drops instead of burying them
+        # in the docstring
+        "dropped_rows": jnp.sum(jnp.maximum(send_sizes - p, 0)),
+    }
     return recv_x.reshape(ep * p, h), recv_local, recv_valid, meta
 
 
@@ -373,6 +380,20 @@ def sorted_moe_forward(
     from scaletorch_tpu.models.layers import swiglu
 
     e_local = gate_proj.shape[0]
+    if e_local > 4:
+        import warnings
+
+        warnings.warn(
+            f"sorted_moe_forward with E_local={e_local}: every local expert "
+            "matmuls the WHOLE receive buffer under a membership mask, so "
+            f"compute scales {e_local}x vs the capacity path's dense slots. "
+            "This path is correctness-tier — for E_local > 4 use the "
+            "capacity dispatch (dispatch_tokens/moe_mlp, the moe_block "
+            "default) or raise expert_parallel_size so each rank holds "
+            "<= 4 experts.",
+            RuntimeWarning,
+            stacklevel=2,
+        )
     recv_c = jnp.where(valid[:, None], recv, 0).astype(cdt)
     out = jnp.zeros(recv.shape, cdt)
     for e in range(e_local):  # static loop; each expert masks its rows
