@@ -109,7 +109,7 @@ def make_bench_args(
     cp: int = 1,
     ep: int = 1,
     sp: bool = False,
-    pp_engine: str = "1f1b",
+    pp_engine: str = "afab",
     dtype: str = "bfloat16",
     remat_policy: str = "nothing_saveable",
     extra: Optional[Dict[str, Any]] = None,

@@ -24,18 +24,20 @@ pp_comms.py:86-286 blocking P2P), re-designed TPU-first:
   * Schedules: ``afab`` differentiates one pipeline over all M microbatches
     (activation memory O(M) stage-boundary carries — ticks are
     rematerialised, so only the [B,S,H] carry per tick is stored, matching
-    AFAB's per-microbatch boundary storage). ``1f1b`` chunks microbatches
-    into groups of pp and accumulates grads chunk-by-chunk, bounding
-    in-flight activations at O(pp) exactly like 1F1B's steady state
-    (reference warmup = pp - rank - 1, pipeline_parallel.py:457-671); the
-    price is a bubble per chunk rather than per step.
+    AFAB's per-microbatch boundary storage). ``memory_chunked`` (config
+    accepts ``1f1b`` as a reference-compat alias, WITH a warning) chunks
+    microbatches into groups of pp and accumulates grads chunk-by-chunk,
+    bounding in-flight activations at O(pp) exactly like 1F1B's steady
+    state (reference warmup = pp - rank - 1, pipeline_parallel.py:457-671);
+    the price is a bubble per chunk rather than per step.
   * Schedule accounting (measured, tools/pp_schedule_compare.py): under
     SPMD every stage ticks in lockstep, so ``afab``'s fwd+bwd pipelines
     cost 2(M+pp-1) ticks — bubble fraction (pp-1)/(M+pp-1), the SAME as
     textbook 1F1B; MPMD-style F/B interleaving would cost M+2(pp-1)
     combined ticks, i.e. strictly more here. 1F1B's remaining advantage
-    is memory, which ``1f1b`` provides: measured 1.25x slower than afab
-    at pp=4/accum=8 (predicted 1.27x from tick counts).
+    is memory, which ``memory_chunked`` provides: measured 1.25x slower
+    than afab at pp=4/accum=8 (predicted 1.27x from tick counts) — hence
+    the honest name: it is 1F1B's memory bound, NOT a faster schedule.
 
 ``stage_layer_partition`` keeps the reference's uneven-layer bookkeeping
 (pipeline_parallel.py:83-133) for checkpoint naming and HF-weight loading;

@@ -174,8 +174,11 @@ def _build_losses(
     if mm.pp == 1:
         return loss_fn, None, False
 
-    if pp_schedule not in ("afab", "1f1b"):
-        raise ValueError(f"pp_schedule must be 'afab' or '1f1b', got {pp_schedule}")
+    if pp_schedule not in ("afab", "memory_chunked", "1f1b"):
+        raise ValueError(
+            "pp_schedule must be 'afab' or 'memory_chunked' (alias '1f1b'), "
+            f"got {pp_schedule}"
+        )
     if custom_pipeline_loss is not None:
         # Custom model families run PP through the public protocol: build
         # a ``(params, batch) -> loss`` with pipeline_spmd_loss over your
@@ -339,8 +342,9 @@ def make_spmd_train_step(
 
     With ``mm.pp > 1`` the microbatch loop becomes the SPMD
     collective-permute pipeline (parallel/pipeline_parallel.py);
-    ``pp_schedule`` selects 'afab' or '1f1b' (reference pp_engine,
-    config.py:155-173) — the accum dim of the batch is the microbatch dim.
+    ``pp_schedule`` selects 'afab' or 'memory_chunked' (programmatic alias
+    '1f1b' — reference pp_engine, config.py:155-173) — the accum dim of
+    the batch is the microbatch dim.
     """
     use_pp = mm.pp > 1
     if (use_pp and custom_pipeline_loss is None

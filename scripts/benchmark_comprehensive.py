@@ -47,37 +47,37 @@ WARMUP_STEPS = 2
 # fmt: off
 CORRECTNESS_CONFIGS = [
     # --- pure DP ---
-    ("tiny-DP8",             "dense-tiny", 1, 1, 8, 1, 1, 2, 2, 256, False, False, "1f1b"),
+    ("tiny-DP8",             "dense-tiny", 1, 1, 8, 1, 1, 2, 2, 256, False, False, "memory_chunked"),
     # --- TP ---
-    ("tiny-TP2-DP4",         "dense-tiny", 2, 1, 4, 1, 1, 2, 1, 256, False, False, "1f1b"),
-    ("tiny-TP4-DP2",         "dense-tiny", 4, 1, 2, 1, 1, 2, 1, 256, False, False, "1f1b"),
+    ("tiny-TP2-DP4",         "dense-tiny", 2, 1, 4, 1, 1, 2, 1, 256, False, False, "memory_chunked"),
+    ("tiny-TP4-DP2",         "dense-tiny", 4, 1, 2, 1, 1, 2, 1, 256, False, False, "memory_chunked"),
     # --- PP (both schedules) ---
-    ("tiny-PP2-DP4",         "dense-tiny", 1, 2, 4, 1, 1, 2, 2, 256, False, False, "1f1b"),
+    ("tiny-PP2-DP4",         "dense-tiny", 1, 2, 4, 1, 1, 2, 2, 256, False, False, "memory_chunked"),
     ("tiny-PP4-DP2-afab",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "afab"),
-    ("tiny-PP4-DP2-1f1b",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "1f1b"),
+    ("tiny-PP4-DP2-1f1b",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "memory_chunked"),
     # --- CP (ring runs the zigzag layout by default; ulysses = the
     # all-to-all head-scatter strategy) ---
-    ("tiny-CP2-DP4",         "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "1f1b"),
-    ("tiny-CP4-DP2-GC",      "dense-tiny", 1, 1, 2, 4, 1, 1, 1, 1024, True, False, "1f1b"),
-    ("tiny-CP2-DP4-ulysses", "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "1f1b",
+    ("tiny-CP2-DP4",         "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "memory_chunked"),
+    ("tiny-CP4-DP2-GC",      "dense-tiny", 1, 1, 2, 4, 1, 1, 1, 1024, True, False, "memory_chunked"),
+    ("tiny-CP2-DP4-ulysses", "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "memory_chunked",
      {"attention_backend": "ulysses"}),
     # --- SP ---
-    ("tiny-SP-TP2-DP4",      "dense-tiny", 2, 1, 4, 1, 1, 2, 1, 256, False, True,  "1f1b"),
+    ("tiny-SP-TP2-DP4",      "dense-tiny", 2, 1, 4, 1, 1, 2, 1, 256, False, True,  "memory_chunked"),
     # --- mixed dense ---
-    ("tiny-TP2-PP2-DP2-GC",  "dense-tiny", 2, 2, 2, 1, 1, 2, 2, 256, True,  False, "1f1b"),
-    ("tiny-TP2-CP2-DP2",     "dense-tiny", 2, 1, 2, 2, 1, 1, 1, 512, False, False, "1f1b"),
-    ("tiny-SP-TP2-CP2-DP2",  "dense-tiny", 2, 1, 2, 2, 1, 1, 1, 512, False, True,  "1f1b"),
-    ("tiny-TP2-PP2-CP2-GC",  "dense-tiny", 2, 2, 1, 2, 1, 1, 2, 512, True,  False, "1f1b"),
+    ("tiny-TP2-PP2-DP2-GC",  "dense-tiny", 2, 2, 2, 1, 1, 2, 2, 256, True,  False, "memory_chunked"),
+    ("tiny-TP2-CP2-DP2",     "dense-tiny", 2, 1, 2, 2, 1, 1, 1, 512, False, False, "memory_chunked"),
+    ("tiny-SP-TP2-CP2-DP2",  "dense-tiny", 2, 1, 2, 2, 1, 1, 1, 512, False, True,  "memory_chunked"),
+    ("tiny-TP2-PP2-CP2-GC",  "dense-tiny", 2, 2, 1, 2, 1, 1, 2, 512, True,  False, "memory_chunked"),
     # --- MoE / EP ---
-    ("moe-DP8",              "moe-tiny",   1, 1, 8, 1, 1, 2, 1, 256, False, False, "1f1b"),
-    ("moe-EP2-DP4",          "moe-tiny",   1, 1, 4, 1, 2, 1, 1, 256, False, False, "1f1b"),
-    ("moe-EP4-DP2",          "moe-tiny",   1, 1, 2, 1, 4, 1, 1, 256, False, False, "1f1b"),
-    ("moe-EP2-TP2-DP2",      "moe-tiny",   2, 1, 2, 1, 2, 1, 1, 256, False, False, "1f1b"),
-    ("moe-EP2-CP2-DP2",      "moe-tiny",   1, 1, 2, 2, 2, 1, 1, 512, False, False, "1f1b"),
-    ("moe-EP2-TP2-CP2-GC",   "moe-tiny",   2, 1, 1, 2, 2, 1, 1, 512, True,  False, "1f1b"),
+    ("moe-DP8",              "moe-tiny",   1, 1, 8, 1, 1, 2, 1, 256, False, False, "memory_chunked"),
+    ("moe-EP2-DP4",          "moe-tiny",   1, 1, 4, 1, 2, 1, 1, 256, False, False, "memory_chunked"),
+    ("moe-EP4-DP2",          "moe-tiny",   1, 1, 2, 1, 4, 1, 1, 256, False, False, "memory_chunked"),
+    ("moe-EP2-TP2-DP2",      "moe-tiny",   2, 1, 2, 1, 2, 1, 1, 256, False, False, "memory_chunked"),
+    ("moe-EP2-CP2-DP2",      "moe-tiny",   1, 1, 2, 2, 2, 1, 1, 512, False, False, "memory_chunked"),
+    ("moe-EP2-TP2-CP2-GC",   "moe-tiny",   2, 1, 1, 2, 2, 1, 1, 512, True,  False, "memory_chunked"),
     # --- PP x EP (MoE pipeline; VERDICT r1 missing #8) ---
     ("moe-PP2-EP2-DP2",      "moe-tiny",   1, 2, 2, 1, 2, 1, 2, 256, False, False, "afab"),
-    ("moe-PP2-EP2-TP2-1f1b", "moe-tiny",   2, 2, 1, 1, 2, 1, 2, 256, False, False, "1f1b"),
+    ("moe-PP2-EP2-TP2-1f1b", "moe-tiny",   2, 2, 1, 1, 2, 1, 2, 256, False, False, "memory_chunked"),
 ]
 
 # The reference's published 8-chip rows (BASELINE.md §8-NPU) + single-chip
@@ -88,23 +88,23 @@ CORRECTNESS_CONFIGS = [
 # needs Adafactor to fit a 16 GB chip; without them this table OOMs where
 # bench.py's rows run, and the two tables silently disagree.
 PERF_CONFIGS = [
-    ("0.6B-single",          "qwen3-0.6b", 1, 1, 1, 1, 1, 1, 1, 8192,  True,  False, "1f1b"),
-    ("0.6B-seq16k-single",   "qwen3-0.6b", 1, 1, 1, 1, 1, 1, 1, 16384, True,  False, "1f1b"),
-    ("0.6B-DP8",             "qwen3-0.6b", 1, 1, 8, 1, 1, 2, 2, 2048,  False, False, "1f1b"),
-    ("0.6B-CP2-DP4",         "qwen3-0.6b", 1, 1, 4, 2, 1, 1, 1, 4096,  False, False, "1f1b"),
-    ("1.7B-DP8-GC",          "qwen3-1.7b", 1, 1, 8, 1, 1, 1, 2, 2048,  True,  False, "1f1b",
+    ("0.6B-single",          "qwen3-0.6b", 1, 1, 1, 1, 1, 1, 1, 8192,  True,  False, "memory_chunked"),
+    ("0.6B-seq16k-single",   "qwen3-0.6b", 1, 1, 1, 1, 1, 1, 1, 16384, True,  False, "memory_chunked"),
+    ("0.6B-DP8",             "qwen3-0.6b", 1, 1, 8, 1, 1, 2, 2, 2048,  False, False, "memory_chunked"),
+    ("0.6B-CP2-DP4",         "qwen3-0.6b", 1, 1, 4, 2, 1, 1, 1, 4096,  False, False, "memory_chunked"),
+    ("1.7B-DP8-GC",          "qwen3-1.7b", 1, 1, 8, 1, 1, 1, 2, 2048,  True,  False, "memory_chunked",
      {"param_dtype": "bfloat16"}),
-    ("1.7B-CP4-DP2-GC",      "qwen3-1.7b", 1, 1, 2, 4, 1, 1, 1, 8192,  True,  False, "1f1b",
+    ("1.7B-CP4-DP2-GC",      "qwen3-1.7b", 1, 1, 2, 4, 1, 1, 1, 8192,  True,  False, "memory_chunked",
      {"param_dtype": "bfloat16"}),
-    ("4B-CP2-DP4-GC",        "qwen3-4b",   1, 1, 4, 2, 1, 1, 1, 4096,  True,  False, "1f1b",
+    ("4B-CP2-DP4-GC",        "qwen3-4b",   1, 1, 4, 2, 1, 1, 1, 4096,  True,  False, "memory_chunked",
      {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
-    ("8B-TP2-CP2-DP2-GC",    "qwen3-8b",   2, 1, 2, 2, 1, 1, 1, 4096,  True,  False, "1f1b",
+    ("8B-TP2-CP2-DP2-GC",    "qwen3-8b",   2, 1, 2, 2, 1, 1, 1, 4096,  True,  False, "memory_chunked",
      {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
-    ("14B-TP4-CP2-GC",       "qwen3-14b",  4, 1, 1, 2, 1, 1, 1, 4096,  True,  False, "1f1b",
+    ("14B-TP4-CP2-GC",       "qwen3-14b",  4, 1, 1, 2, 1, 1, 1, 4096,  True,  False, "memory_chunked",
      {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
-    ("32B-TP8-SEQ4K-GC",     "qwen3-32b",  8, 1, 1, 1, 1, 1, 1, 4096,  True,  False, "1f1b",
+    ("32B-TP8-SEQ4K-GC",     "qwen3-32b",  8, 1, 1, 1, 1, 1, 1, 4096,  True,  False, "memory_chunked",
      {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
-    ("30B-A3B-EP2-TP4",      "qwen3-30b-a3b", 4, 1, 1, 1, 2, 1, 1, 4096, False, False, "1f1b",
+    ("30B-A3B-EP2-TP4",      "qwen3-30b-a3b", 4, 1, 1, 1, 2, 1, 1, 4096, False, False, "memory_chunked",
      {"param_dtype": "bfloat16", "optimizer_name": "adafactor"}),
 ]
 # fmt: on

@@ -76,10 +76,17 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
     from scaletorch_tpu.trainer.trainer import build_model_config
 
     world = dp * tp * cp * pp * ep
-    topo = topologies.get_topology_desc(
-        platform="tpu", topology_name=f"{gen}:2x2x1")
-    if world > len(topo.devices):
-        raise ValueError(f"mesh {world} devices > topology {len(topo.devices)}")
+    # smallest AOT topology that holds the mesh (v5e slices are 2D grids;
+    # 4 chips = one host, 8/16 = multi-host slices — ICI collective
+    # lowering is validated either way)
+    for shape, n in (("2x2x1", 4), ("2x4x1", 8), ("4x4x1", 16),
+                     ("4x8x1", 32)):
+        if world <= n:
+            topo = topologies.get_topology_desc(
+                platform="tpu", topology_name=f"{gen}:{shape}")
+            break
+    else:
+        raise ValueError(f"mesh {world} devices > largest AOT topology (32)")
     cfg = make_bench_args(model, seq=seq, micro_bs=micro_bs,
                           grad_accum=grad_accum, gc=gc,
                           remat_policy=remat_policy,
@@ -196,9 +203,9 @@ def main() -> None:
     for ax in ("dp", "tp", "cp", "pp", "ep"):
         ap.add_argument(f"--{ax}", type=int, default=1)
     ap.add_argument("--sp", action="store_true", help="sequence parallel")
-    ap.add_argument("--pp-engine", default="afab", choices=["afab", "1f1b"],
+    ap.add_argument("--pp-engine", default="afab", choices=["afab", "memory_chunked", "1f1b"],
                     help="pipeline schedule to analyze (afab is the "
-                         "config/train.py default; 1f1b is the O(pp)-memory "
+                         "config/train.py default; memory_chunked (alias 1f1b) is the O(pp)-memory "
                          "chunked schedule)")
     ap.add_argument("--policies", nargs="*", default=None,
                     help="remat policies to compare (implies --gc)")

@@ -12,7 +12,7 @@ accounting differs:
           because idle SPMD stages burn their tick either way — manual
           F/B interleaving would cost M + 2(pp-1) combined ticks, i.e.
           strictly more. Boundary-activation memory is O(M).
-  1f1b  : chunked accumulation in groups of pp microbatches
+  memory_chunked (reference-compat alias: 1f1b) : chunked accumulation in groups of pp microbatches
           => 1F1B's O(pp) boundary memory, at bubble fraction
           (pp-1)/(2*pp-1) per chunk.
 
@@ -67,7 +67,7 @@ def main() -> None:
     from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
 
     results = {}
-    for engine in ("afab", "1f1b"):
+    for engine in ("afab", "memory_chunked"):
         cfg = make_bench_args(
             args.model, seq=args.seq, pp=args.pp, dp=args.dp,
             grad_accum=args.accum, pp_engine=engine, dtype="float32",
@@ -85,19 +85,19 @@ def main() -> None:
         "chunked_bubble": (pp - 1) / (2 * pp - 1),
     }
     measured_ratio = (
-        results["1f1b"]["step_time_s"] / results["afab"]["step_time_s"]
+        results["memory_chunked"]["step_time_s"] / results["afab"]["step_time_s"]
     )
     predicted_ratio = pred["chunked_ticks"] / pred["afab_ticks"]
     out = {
         "geometry": {"pp": pp, "dp": args.dp, "accum": m, "seq": args.seq},
         "afab": results["afab"],
-        "1f1b_chunked": results["1f1b"],
+        "memory_chunked": results["memory_chunked"],
         "predicted": pred,
-        "measured_slowdown_1f1b_vs_afab": round(measured_ratio, 3),
-        "predicted_slowdown_1f1b_vs_afab": round(predicted_ratio, 3),
+        "measured_slowdown_chunked_vs_afab": round(measured_ratio, 3),
+        "predicted_slowdown_chunked_vs_afab": round(predicted_ratio, 3),
         "recommendation": (
             "afab (1F1B-equivalent bubble, more boundary-activation memory); "
-            "use 1f1b only when O(accum) boundary carries do not fit"
+            "use memory_chunked only when O(accum) boundary carries do not fit"
         ),
     }
     print(json.dumps(out, indent=1))
