@@ -37,13 +37,29 @@ class MetricsLogger:
     num_chips: int = 1
     log_frequency: int = 1
     peak_flops: Optional[float] = None
+    collect_system: bool = True   # host CPU/mem + accel env per logged step
     history: list = field(default_factory=list)
     _window_start_time: Optional[float] = None
     _window_start_step: Optional[int] = None
+    _monitor: Optional[object] = None
 
     def __post_init__(self) -> None:
         if self.peak_flops is None:
             self.peak_flops = get_theoretical_flops()
+        if self.collect_system:
+            # reference PerformanceMonitor role (utils/monitor.py:69-162):
+            # host CPU/memory/load + power/temp where exposed, sampled on
+            # logging steps only so the hot path stays sync-free. psutil
+            # is not a hard dependency — degrade to no system telemetry
+            # rather than failing every entry point at startup.
+            try:
+                from scaletorch_tpu.utils.monitor import SystemMonitor
+
+                self._monitor = SystemMonitor()
+            except ImportError:
+                get_logger().info(
+                    "psutil not available: system telemetry disabled"
+                )
 
     def log_step(self, step: int, loss, lr: float, grad_norm,
                  extras: Optional[dict] = None) -> dict:
@@ -95,6 +111,16 @@ class MetricsLogger:
         if mem["bytes_in_use"]:
             record["memory_gb"] = mem["bytes_in_use"] / 1e9
             record["peak_memory_gb"] = mem["peak_bytes_in_use"] / 1e9
+        if self._monitor is not None:
+            # reuse the stats fetched above (no second allocator poll) and
+            # skip the monitor's device_(peak_)mem_gb aliases of the
+            # memory_gb/peak_memory_gb fields already written
+            sys_rec = self._monitor.sample(step, device_stats=mem)
+            record.update(
+                (k, v) for k, v in sys_rec.items()
+                if k not in ("time", "step", "device_mem_gb",
+                             "device_peak_mem_gb")
+            )
         self.history.append(record)
 
         if jax.process_index() == 0:
@@ -135,6 +161,8 @@ class MetricsLogger:
                 "mean_mfu": sum(r["mfu"] for r in self.history
                                 if "mfu" in r) / len(rates),
             }
+        if self._monitor is not None:
+            summary = {**summary, **self._monitor.summary()}
         with open(path, "w") as f:
             json.dump(
                 {

@@ -77,11 +77,18 @@ def device_memory_stats(device: Optional[jax.Device] = None) -> dict[str, float]
     """
     device = device or jax.local_devices()[0]
     stats = device.memory_stats() or {}
-    return {
+    out = {
         "bytes_in_use": float(stats.get("bytes_in_use", 0)),
         "peak_bytes_in_use": float(stats.get("peak_bytes_in_use", 0)),
         "bytes_limit": float(stats.get("bytes_limit", 0)),
     }
+    # allocator extras some backends export (consumed by utils/monitor.py
+    # for the fragmentation stat); absent keys stay absent — optional
+    for k in ("largest_free_block_bytes", "bytes_reservable_limit",
+              "num_allocs", "peak_pool_bytes"):
+        if k in stats:
+            out[k] = float(stats[k])
+    return out
 
 
 def is_tpu() -> bool:

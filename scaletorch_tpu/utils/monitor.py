@@ -1,0 +1,155 @@
+"""Host/device system telemetry sampled per logging step.
+
+Role parity with reference ``scaletorch/utils/monitor.py:34-292``
+(PerformanceMonitor): per-iteration host CPU / memory / load, device
+memory + fragmentation, and accelerator power/temperature where the
+platform exposes them, collected into a capped ring buffer so a wedged
+multi-hour run can always be diagnosed from its tail.
+
+TPU-first differences from the reference:
+
+  * the reference polls pynvml/npu-smi per GPU; TPU VMs expose no
+    userspace power/temperature interface through JAX, so those fields
+    are populated only when a platform source exists (``/sys`` hwmon or
+    the ``TPU_METRICS_DIR`` sidecar some runtimes provide) and are
+    omitted otherwise — absent, never fabricated;
+  * device memory comes from ``jax`` ``memory_stats()`` (bytes_in_use /
+    peak / limit) and fragmentation is derived from the allocator's own
+    counters (largest_free_block vs free) when present.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+from collections import deque
+from typing import Any, Deque, Dict, Optional
+
+from scaletorch_tpu.utils.device import device_memory_stats
+
+
+def _read_first_number(path: str) -> Optional[float]:
+    try:
+        with open(path) as f:
+            return float(f.read().strip().split()[0])
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def read_accelerator_environment() -> Dict[str, float]:
+    """Power (W) / temperature (C) from whatever the platform exposes.
+
+    Checks, in order: hwmon temperature/power channels (present on some
+    TPU VM images), then any ``TPU_METRICS_DIR`` text files named
+    ``power``/``temp``. Returns {} when nothing is exposed — callers and
+    JSON consumers must treat these fields as optional.
+    """
+    out: Dict[str, float] = {}
+    for temp_path in sorted(glob.glob("/sys/class/hwmon/hwmon*/temp1_input")):
+        v = _read_first_number(temp_path)
+        if v is not None:
+            out["accel_temp_c"] = v / 1000.0  # hwmon reports millidegrees
+            break
+    for power_path in sorted(
+        glob.glob("/sys/class/hwmon/hwmon*/power1_average")
+    ):
+        v = _read_first_number(power_path)
+        if v is not None:
+            out["accel_power_w"] = v / 1e6  # hwmon reports microwatts
+            break
+    metrics_dir = os.environ.get("TPU_METRICS_DIR", "")
+    if metrics_dir:
+        for name, key, scale in (
+            ("power", "accel_power_w", 1.0),
+            ("temp", "accel_temp_c", 1.0),
+        ):
+            v = _read_first_number(os.path.join(metrics_dir, name))
+            if v is not None:
+                out.setdefault(key, v * scale)
+    return out
+
+
+class SystemMonitor:
+    """Capped-history sampler of host + device health.
+
+    ``sample()`` is cheap (psutil counters + one allocator poll, no
+    device sync) and is intended to ride the metrics logger's logging
+    steps; ``max_records`` bounds memory exactly like the reference's
+    ring buffer (monitor.py:34-69 keeps a capped deque so week-long runs
+    don't grow without bound).
+    """
+
+    def __init__(self, max_records: int = 1024):
+        # psutil is present in every supported runtime image but is NOT a
+        # hard package dependency: raise ImportError here (callers like
+        # MetricsLogger degrade to collect_system=False) rather than
+        # crashing every training entry point at startup.
+        import psutil
+
+        self._psutil = psutil
+        self._proc = psutil.Process()
+        # prime the interval-less cpu_percent counters (first call is 0.0)
+        psutil.cpu_percent(interval=None)
+        self._proc.cpu_percent(interval=None)
+        self.records: Deque[Dict[str, Any]] = deque(maxlen=max_records)
+
+    def sample(self, step: Optional[int] = None,
+               device_stats: Optional[Dict[str, float]] = None
+               ) -> Dict[str, Any]:
+        """One telemetry record. ``device_stats``: pass an already-fetched
+        ``device_memory_stats()`` dict to avoid a second allocator poll
+        (the metrics logger polls it for its own fields each logged
+        step)."""
+        psutil = self._psutil
+        vm = psutil.virtual_memory()
+        record: Dict[str, Any] = {
+            "time": time.time(),
+            # host CPU: system-wide and this process, since the last call
+            "host_cpu_percent": psutil.cpu_percent(interval=None),
+            "process_cpu_percent": self._proc.cpu_percent(interval=None),
+            "host_mem_percent": vm.percent,
+            "host_mem_used_gb": vm.used / 1e9,
+            "process_rss_gb": self._proc.memory_info().rss / 1e9,
+            "load_avg_1m": os.getloadavg()[0],
+        }
+        if step is not None:
+            record["step"] = step
+
+        mem = device_stats if device_stats is not None \
+            else device_memory_stats()
+        if mem.get("bytes_in_use"):
+            record["device_mem_gb"] = mem["bytes_in_use"] / 1e9
+            record["device_peak_mem_gb"] = mem["peak_bytes_in_use"] / 1e9
+            if mem.get("bytes_limit"):
+                record["device_mem_percent"] = (
+                    100.0 * mem["bytes_in_use"] / mem["bytes_limit"]
+                )
+            # allocator fragmentation: how much of the free pool is NOT in
+            # the largest contiguous block (reference fragmentation stat,
+            # monitor.py:162-190); only when the allocator exports both
+            free = mem.get("bytes_reservable_limit") or mem.get("bytes_limit")
+            largest = mem.get("largest_free_block_bytes")
+            if largest is not None and free and free > mem["bytes_in_use"]:
+                free_bytes = free - mem["bytes_in_use"]
+                record["device_fragmentation"] = max(
+                    0.0, 1.0 - largest / free_bytes
+                )
+        record.update(read_accelerator_environment())
+        self.records.append(record)
+        return record
+
+    def summary(self) -> Dict[str, float]:
+        """Mean/max over the retained window, per numeric field."""
+        out: Dict[str, float] = {}
+        if not self.records:
+            return out
+        keys = {
+            k for r in self.records for k, v in r.items()
+            if isinstance(v, (int, float)) and k not in ("time", "step")
+        }
+        for k in sorted(keys):
+            vals = [r[k] for r in self.records if k in r]
+            out[f"mean_{k}"] = sum(vals) / len(vals)
+            out[f"max_{k}"] = max(vals)
+        return out

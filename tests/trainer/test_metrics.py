@@ -44,3 +44,38 @@ class TestSaveJson:
         # windows after the first logged step carry rate metrics
         assert "tokens_per_second" in data["records"][-1]
         assert data["summary"]["mean_tokens_per_second"] > 0
+
+
+class TestSystemTelemetry:
+    """Reference PerformanceMonitor parity (utils/monitor.py:69-162):
+    host CPU/memory fields ride every logged record and the JSON dump."""
+
+    def test_host_fields_in_records_and_json(self, tmp_path):
+        m = make_logger()
+        rec = m.log_step(1, loss=2.0, lr=1e-3, grad_norm=0.5)
+        for k in ("host_cpu_percent", "host_mem_percent",
+                  "host_mem_used_gb", "process_rss_gb", "load_avg_1m"):
+            assert k in rec, k
+        assert rec["process_rss_gb"] > 0
+        assert 0 <= rec["host_mem_percent"] <= 100
+        path = m.save_json(str(tmp_path / "log.json"))
+        with open(path) as f:
+            data = json.load(f)
+        assert "host_cpu_percent" in data["records"][0]
+        assert data["summary"]["max_process_rss_gb"] > 0
+
+    def test_opt_out(self):
+        m = make_logger(collect_system=False)
+        rec = m.log_step(1, loss=2.0, lr=1e-3, grad_norm=0.5)
+        assert "host_cpu_percent" not in rec
+
+    def test_ring_buffer_caps_history(self):
+        from scaletorch_tpu.utils.monitor import SystemMonitor
+
+        mon = SystemMonitor(max_records=4)
+        for i in range(10):
+            mon.sample(i)
+        assert len(mon.records) == 4
+        assert mon.records[-1]["step"] == 9
+        s = mon.summary()
+        assert "mean_host_cpu_percent" in s and "max_load_avg_1m" in s
