@@ -24,7 +24,7 @@ and token exchange.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -46,6 +46,12 @@ from scaletorch_tpu.parallel.expert_parallel import (
 from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
 
+def _grouped_mlp_env_default() -> bool:
+    from scaletorch_tpu.env import get_env
+
+    return bool(get_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL"))
+
+
 @dataclass(frozen=True)
 class Qwen3MoEConfig(Qwen3Config):
     # Qwen3-30B-A3B-style knobs (reference model_qwen3_moe.py + HF config)
@@ -57,71 +63,172 @@ class Qwen3MoEConfig(Qwen3Config):
     z_loss_coef: float = 0.0
     norm_topk_prob: bool = True
     tie_word_embeddings: bool = False
+    # Interleaved dense/sparse architecture knobs (HF Qwen3MoeConfig):
+    # layer i runs a dense SwiGLU MLP (intermediate_size) instead of the
+    # MoE block when i is in mlp_only_layers OR (i+1) % decoder_sparse_step
+    # != 0 — the exact HF predicate (modeling_qwen3_moe.Qwen3MoeDecoderLayer).
+    mlp_only_layers: Tuple[int, ...] = ()
+    decoder_sparse_step: int = 1
+    # Slot-skipping Pallas expert kernel (ops/pallas/grouped_mlp.py). The
+    # env toggle is read ONCE, at config construction (host side) — never
+    # at trace time inside the jitted model, so two models with different
+    # settings coexist in one process and post-compile env flips are
+    # (correctly) inert. Pass the field explicitly to override the env.
+    use_grouped_mlp_kernel: bool = field(
+        default_factory=lambda: _grouped_mlp_env_default())
+
+    def __post_init__(self) -> None:
+        # frozen dataclass: coerce a list argument to a hashable tuple
+        object.__setattr__(self, "mlp_only_layers",
+                           tuple(self.mlp_only_layers))
+        if self.decoder_sparse_step < 1:
+            raise ValueError(
+                f"decoder_sparse_step must be >= 1, got "
+                f"{self.decoder_sparse_step}"
+            )
+        bad = [i for i in self.mlp_only_layers
+               if not 0 <= i < self.num_hidden_layers]
+        if bad:
+            raise ValueError(
+                f"mlp_only_layers indices {bad} out of range for "
+                f"{self.num_hidden_layers} layers"
+            )
+        if not any(self.sparse_layout()):
+            raise ValueError(
+                "no layer is sparse under mlp_only_layers="
+                f"{self.mlp_only_layers} / decoder_sparse_step="
+                f"{self.decoder_sparse_step}; use the dense Qwen3 family "
+                "instead"
+            )
+
+    # ---- interleaved dense/sparse layout helpers -------------------------
+
+    def layer_is_sparse(self, layer_idx: int) -> bool:
+        """HF parity predicate (modeling_qwen3_moe.Qwen3MoeDecoderLayer):
+        sparse iff not an mlp-only layer AND (idx+1) divisible by
+        decoder_sparse_step."""
+        return (
+            layer_idx not in self.mlp_only_layers
+            and self.num_experts > 0
+            and (layer_idx + 1) % self.decoder_sparse_step == 0
+        )
+
+    def sparse_layout(self) -> Tuple[bool, ...]:
+        return tuple(
+            self.layer_is_sparse(i) for i in range(self.num_hidden_layers)
+        )
+
+    @property
+    def is_uniform_sparse(self) -> bool:
+        return all(self.sparse_layout())
+
+    def sparse_layer_ids(self) -> Tuple[int, ...]:
+        return tuple(i for i, s in enumerate(self.sparse_layout()) if s)
+
+    def dense_layer_ids(self) -> Tuple[int, ...]:
+        return tuple(i for i, s in enumerate(self.sparse_layout()) if not s)
+
+    def moe_segments(self) -> Tuple[Tuple[bool, int, int], ...]:
+        """Contiguous (is_sparse, lo, hi) runs of same-kind layers — the
+        scan segments of the interleaved forward (each segment is one
+        ``lax.scan`` over its sliced layer stack)."""
+        layout = self.sparse_layout()
+        segs = []
+        lo = 0
+        for i in range(1, len(layout) + 1):
+            if i == len(layout) or layout[i] != layout[lo]:
+                segs.append((layout[lo], lo, i))
+                lo = i
+        return tuple(segs)
 
     @classmethod
     def from_hf(cls, hf_config, **overrides) -> "Qwen3MoEConfig":
-        # This build is all-MoE (every layer sparse); reject HF configs
-        # with interleaved dense layers rather than silently building a
-        # different architecture.
-        if getattr(hf_config, "mlp_only_layers", None):
-            raise NotImplementedError(
-                "mlp_only_layers (interleaved dense layers) is not supported"
-            )
-        if getattr(hf_config, "decoder_sparse_step", 1) not in (0, 1):
-            raise NotImplementedError(
-                "decoder_sparse_step > 1 (interleaved dense layers) is not "
-                "supported"
-            )
         kw = dict(
             num_experts=getattr(hf_config, "num_experts", 8),
             num_experts_per_tok=getattr(hf_config, "num_experts_per_tok", 2),
             moe_intermediate_size=getattr(hf_config, "moe_intermediate_size", 768),
             norm_topk_prob=getattr(hf_config, "norm_topk_prob", True),
+            mlp_only_layers=tuple(
+                getattr(hf_config, "mlp_only_layers", None) or ()),
+            decoder_sparse_step=getattr(hf_config, "decoder_sparse_step", 1)
+            or 1,
         )
         kw.update(overrides)
         return super().from_hf(hf_config, **kw)
 
     def num_params(self) -> int:
-        h, l, v = self.hidden_size, self.num_hidden_layers, self.vocab_size
+        h, v = self.hidden_size, self.vocab_size
+        n_sparse = sum(self.sparse_layout())
+        n_dense = self.num_hidden_layers - n_sparse
         attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
         moe = self.num_experts * 3 * h * self.moe_intermediate_size
+        dense_mlp = 3 * h * self.intermediate_size
         router = h * self.num_experts
         norms = 2 * h + (2 * self.actual_head_dim if self.qk_norm else 0)
-        per_layer = attn + moe + router + norms
+        per_common = attn + norms
         head = 0 if self.tie_word_embeddings else v * h
-        return l * per_layer + v * h + h + head
+        return (
+            self.num_hidden_layers * per_common
+            + n_sparse * (moe + router)
+            + n_dense * dense_mlp
+            + v * h + h + head
+        )
 
     def num_active_params(self) -> int:
-        """Active parameters per token (top-k experts) — the MFU
-        denominator the reference uses for MoE tables (README.md:131)."""
-        h, l, v = self.hidden_size, self.num_hidden_layers, self.vocab_size
+        """Active parameters per token (top-k experts on sparse layers,
+        the full MLP on dense layers) — the MFU denominator the reference
+        uses for MoE tables (README.md:131)."""
+        h, v = self.hidden_size, self.vocab_size
+        n_sparse = sum(self.sparse_layout())
+        n_dense = self.num_hidden_layers - n_sparse
         attn = h * self.q_size + 2 * h * self.kv_size + self.q_size * h
         moe = self.num_experts_per_tok * 3 * h * self.moe_intermediate_size
+        dense_mlp = 3 * h * self.intermediate_size
         router = h * self.num_experts
         norms = 2 * h + (2 * self.actual_head_dim if self.qk_norm else 0)
         head = 0 if self.tie_word_embeddings else v * h
-        return l * (attn + moe + router + norms) + v * h + h + head
+        return (
+            self.num_hidden_layers * (attn + norms)
+            + n_sparse * (moe + router)
+            + n_dense * dense_mlp
+            + v * h + h + head
+        )
 
 
 def init_params(key: jax.Array, cfg: Qwen3MoEConfig) -> Params:
     """Dense attention params from the Llama initializer (mlp=False); MoE
-    params take the dense MLP keys' place (stacked [L, E, ...])."""
-    l, h, e = cfg.num_hidden_layers, cfg.hidden_size, cfg.num_experts
+    params take the dense MLP keys' place.
+
+    Stacked layout: attention/norm keys span ALL layers [L, ...]; the MoE
+    keys are stacked over the SPARSE layer subset [L_sparse, ...] and —
+    for interleaved dense/sparse configs (mlp_only_layers /
+    decoder_sparse_step, HF Qwen3MoeConfig) — the dense SwiGLU keys over
+    the DENSE subset [L_dense, H, intermediate_size]. All-sparse configs
+    (L_sparse == L, no dense keys) keep the round-1 layout unchanged.
+    """
+    h, e = cfg.hidden_size, cfg.num_experts
     i = cfg.moe_intermediate_size
+    ls = len(cfg.sparse_layer_ids())
+    ld = cfg.num_hidden_layers - ls
     pd = cfg.param_dtype
     base = _llama.init_params(key, cfg, mlp=False)
     layers = base["layers"]
-    keys = jax.random.split(jax.random.fold_in(key, 7), 4)
+    keys = jax.random.split(jax.random.fold_in(key, 7), 7)
 
     def expert_stack(k, shape, fan_in):
         # one batched draw: fan-in-uniform bounds depend only on fan_in,
         # so [L, E, ...] in a single RNG call is distributionally identical
-        return fan_in_uniform(k, (l, e) + shape, fan_in, pd)
+        return fan_in_uniform(k, (ls, e) + shape, fan_in, pd)
 
-    layers["router"] = 0.02 * jax.random.normal(keys[0], (l, h, e), pd)
+    layers["router"] = 0.02 * jax.random.normal(keys[0], (ls, h, e), pd)
     layers["expert_gate_proj"] = expert_stack(keys[1], (h, i), h)
     layers["expert_up_proj"] = expert_stack(keys[2], (h, i), h)
     layers["expert_down_proj"] = expert_stack(keys[3], (i, h), i)
+    if ld:
+        di = cfg.intermediate_size
+        layers["gate_proj"] = fan_in_uniform(keys[4], (ld, h, di), h, pd)
+        layers["up_proj"] = fan_in_uniform(keys[5], (ld, h, di), h, pd)
+        layers["down_proj"] = fan_in_uniform(keys[6], (ld, di, h), di, pd)
     return base
 
 
@@ -171,9 +278,7 @@ def moe_block(
     aux = {k: jnp.mean(v, axis=0) for k, v in aux.items()}  # mean over groups
     slots = dispatch_tokens(h_full, dispatch, axis=ep_axis)
     kernel_extra = {}
-    from scaletorch_tpu.env import get_env
-
-    if get_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL"):
+    if cfg.use_grouped_mlp_kernel:
         # slot-skipping expert kernel: per-(expert, group) fill counts
         # ride the same exchange layout as the slots
         from scaletorch_tpu.ops.pallas.grouped_mlp import slot_fill_counts
@@ -279,6 +384,92 @@ def moe_decoder_stack(
     return x, aux_loss, moe_stats
 
 
+_ATTN_KEYS = (
+    "input_layernorm", "q_proj", "k_proj", "v_proj", "o_proj",
+    "post_attention_layernorm", "q_norm", "k_norm",
+)
+_MOE_KEYS = ("router", "expert_gate_proj", "expert_up_proj",
+             "expert_down_proj")
+_DENSE_KEYS = ("gate_proj", "up_proj", "down_proj")
+
+
+def interleaved_decoder_stack(
+    x: jax.Array,
+    layers: Params,
+    cos: jax.Array,
+    sin: jax.Array,
+    cfg: Qwen3MoEConfig,
+    attn_fn: Callable,
+    helpers,
+    *,
+    tp_axis: Optional[str] = None,
+    ep_axis: Optional[str] = None,
+    sequence_parallel: bool = False,
+    gradient_checkpointing: bool = False,
+    remat_policy: str = "nothing_saveable",
+) -> Tuple[jax.Array, jax.Array, dict]:
+    """Mixed dense/sparse decoder (HF ``mlp_only_layers`` /
+    ``decoder_sparse_step`` architectures, modeling_qwen3_moe
+    Qwen3MoeDecoderLayer; reference checkpoint mapping is generic over
+    these configs, utils/checkpoint.py:425-464).
+
+    TPU-first shape: the layer sequence is cut into contiguous same-kind
+    segments (``cfg.moe_segments()``) and each segment runs as ONE
+    ``lax.scan`` over its sliced parameter stack — compile time stays
+    O(#segments), not O(L), and each segment body is the already-optimised
+    uniform scan (``moe_decoder_stack`` / ``llama.decoder_stack``). Slices
+    are static (config-derived), so XLA sees plain constant-offset views
+    of the stacked weights. A dense segment is exactly the Llama SwiGLU
+    body, so TP/SP compose identically; sparse segments add EP.
+
+    Returns (hidden, aux_loss_sum, stats) with stats averaged over SPARSE
+    layers only (dense layers have no routing health to report).
+    """
+    aux_total = jnp.float32(0.0)
+    stats_sum: dict = {}
+    n_sparse = 0
+    d_off = s_off = 0
+    for is_sparse, lo, hi in cfg.moe_segments():
+        n = hi - lo
+        attn_slice = {
+            k: layers[k][lo:hi] for k in _ATTN_KEYS if k in layers
+        }
+        if is_sparse:
+            seg = dict(attn_slice, **{
+                k: layers[k][s_off:s_off + n] for k in _MOE_KEYS})
+            x, aux, stats = moe_decoder_stack(
+                x, seg, cos, sin, cfg, attn_fn, helpers,
+                tp_axis=tp_axis, ep_axis=ep_axis,
+                sequence_parallel=sequence_parallel,
+                gradient_checkpointing=gradient_checkpointing,
+                remat_policy=remat_policy,
+            )
+            aux_total = aux_total + aux
+            # moe_decoder_stack returns per-segment layer means; recombine
+            # weighted by segment length for the model-level mean
+            for k, v in stats.items():
+                stats_sum[k] = stats_sum.get(k, 0.0) + n * v
+            n_sparse += n
+            s_off += n
+        else:
+            seg = dict(attn_slice, **{
+                k: layers[k][d_off:d_off + n] for k in _DENSE_KEYS})
+            x = _llama.decoder_stack(
+                x, seg, cos, sin, cfg, attn_fn,
+                tp_axis=tp_axis, sequence_parallel=sequence_parallel,
+                gradient_checkpointing=gradient_checkpointing,
+                remat_policy=remat_policy,
+            )
+            extra = tuple(a for a in (tp_axis, ep_axis) if a)
+            if extra:
+                # keep the carry's varying-axis set stable across segment
+                # kinds (the sparse segments pin (tp, ep))
+                x = pvary_missing(x, extra)
+            d_off += n
+    stats = {k: v / n_sparse for k, v in stats_sum.items()}
+    return x, aux_total, stats
+
+
 def forward(
     params: Params,
     input_ids: jax.Array,
@@ -314,7 +505,9 @@ def forward(
     # the MoE combine einsum re-marks the residual as varying over tp (the
     # combine weights come from the tp-varied router), so it pins both the
     # initial carry and the per-layer outputs to the same vma.
-    x, aux_loss, moe_stats = moe_decoder_stack(
+    stack = (moe_decoder_stack if cfg.is_uniform_sparse
+             else interleaved_decoder_stack)
+    x, aux_loss, moe_stats = stack(
         x, params["layers"], cos, sin, cfg, attn_fn, helpers,
         tp_axis=tp_axis, ep_axis=ep_axis,
         sequence_parallel=sequence_parallel,
@@ -350,14 +543,28 @@ def qwen3_moe_param_specs(
     experts sharded over ep on the expert dim and over tp on the
     intermediate dim (reference EP×TP composition,
     model_qwen3_moe.py:192-207); the router replicated (reference
-    :192-207 keeps the gate replicated)."""
+    :192-207 keeps the gate replicated).
+
+    Interleaved dense/sparse configs keep the dense SwiGLU specs from
+    llama_param_specs for their [L_dense, ...] stacks; PP is not
+    composable there (the MoE/dense stacks' leading axes are layer
+    SUBSETS, which do not align with a pp-sharded attention stack)."""
     from scaletorch_tpu.parallel.tensor_parallel import llama_param_specs
 
     t, ep, pstg = tp_axis, ep_axis, pp_axis
+    if not cfg.is_uniform_sparse and pstg is not None:
+        raise NotImplementedError(
+            "pipeline parallelism over an interleaved dense/sparse "
+            "Qwen3-MoE is not supported: the per-kind layer stacks "
+            f"(sparse {cfg.sparse_layer_ids()}, dense "
+            f"{cfg.dense_layer_ids()}) do not align with a pp-sharded "
+            "stacked layer axis — run this architecture with pp=1"
+        )
     specs = llama_param_specs(cfg, tp_axis=t, pp_axis=pstg)
     layers = specs["layers"]
-    for k in ("gate_proj", "up_proj", "down_proj"):
-        del layers[k]
+    if cfg.is_uniform_sparse:
+        for k in ("gate_proj", "up_proj", "down_proj"):
+            del layers[k]
     layers["router"] = P(pstg, None, None)
     layers["expert_gate_proj"] = P(pstg, ep, None, t)
     layers["expert_up_proj"] = P(pstg, ep, None, t)

@@ -185,21 +185,21 @@ def load_hf_params(
         t = _debf16(np.asarray(get(template.format(**fmt))))
         return t.T if transpose else t
 
-    l = cfg.num_hidden_layers
     layers: Params = {}
     for key in _layer_keys_for(cfg):
         template, transpose = _LAYER_MAP[key]
+        ids = _layer_ids_for(cfg, key)
         if "{e}" in template:
             stacked = np.stack([
                 np.stack([
                     fetch(template, transpose, i=i, e=e)
                     for e in range(cfg.num_experts)
                 ])
-                for i in range(l)
+                for i in ids
             ])
         else:
             stacked = np.stack(
-                [fetch(template, transpose, i=i) for i in range(l)]
+                [fetch(template, transpose, i=i) for i in ids]
             )
         layers[key] = stacked.astype(pd)
 
@@ -244,9 +244,34 @@ def _layer_keys_for(cfg) -> list:
     if hasattr(cfg, "num_experts"):
         keys += ["router", "expert_gate_proj", "expert_up_proj",
                  "expert_down_proj"]
+        # interleaved dense/sparse (mlp_only_layers / decoder_sparse_step):
+        # the dense subset carries plain SwiGLU stacks alongside
+        if _layer_ids_for(cfg, "gate_proj"):
+            keys += ["gate_proj", "up_proj", "down_proj"]
     else:
         keys += ["gate_proj", "up_proj", "down_proj"]
     return keys
+
+
+def _layer_ids_for(cfg, key: str) -> list:
+    """Global HF layer indices backing row r of OUR stacked leaf ``key``.
+
+    Uniform models stack every key over all layers. Interleaved
+    dense/sparse MoE configs (HF mlp_only_layers / decoder_sparse_step;
+    reference checkpoint mapping is generic over them,
+    checkpoint.py:425-464) stack the MoE keys over the sparse subset and
+    the SwiGLU keys over the dense subset.
+    """
+    full = list(range(cfg.num_hidden_layers))
+    if not hasattr(cfg, "num_experts"):
+        return full
+    sparse = list(getattr(cfg, "sparse_layer_ids", lambda: full)())
+    if key in ("router", "expert_gate_proj", "expert_up_proj",
+               "expert_down_proj"):
+        return sparse
+    if key in ("gate_proj", "up_proj", "down_proj"):
+        return [i for i in full if i not in sparse]
+    return full
 
 
 def _load_hf_params_streamed(
@@ -275,33 +300,34 @@ def _load_hf_params_streamed(
         name = template
         return lambda idx: _read_hf_slice(handle_for(name), name, idx, transpose)
 
-    def stacked_cb(template: str, transpose: bool):
-        """[L, *inner] leaf: idx[0] selects this shard's layer block."""
+    def stacked_cb(template: str, transpose: bool, ids: list):
+        """[len(ids), *inner] leaf: idx[0] selects this shard's block of
+        stacked rows; ``ids`` maps each row to its global HF layer."""
         def cb(idx):
             lsl, inner = idx[0], tuple(idx[1:])
             parts = [
                 _read_hf_slice(
-                    handle_for(template.format(i=i)),
-                    template.format(i=i), inner, transpose,
+                    handle_for(template.format(i=ids[r])),
+                    template.format(i=ids[r]), inner, transpose,
                 )
-                for i in range(*lsl.indices(cfg.num_hidden_layers))
+                for r in range(*lsl.indices(len(ids)))
             ]
             return np.stack(parts)
         return cb
 
-    def expert_cb(template: str, transpose: bool):
-        """[L, E, *inner] leaf: layer AND expert ranges per shard."""
+    def expert_cb(template: str, transpose: bool, ids: list):
+        """[len(ids), E, *inner] leaf: layer AND expert ranges per shard."""
         def cb(idx):
             lsl, esl, inner = idx[0], idx[1], tuple(idx[2:])
             return np.stack([
                 np.stack([
                     _read_hf_slice(
-                        handle_for(template.format(i=i, e=e)),
-                        template.format(i=i, e=e), inner, transpose,
+                        handle_for(template.format(i=ids[r], e=e)),
+                        template.format(i=ids[r], e=e), inner, transpose,
                     )
                     for e in range(*esl.indices(cfg.num_experts))
                 ])
-                for i in range(*lsl.indices(cfg.num_hidden_layers))
+                for r in range(*lsl.indices(len(ids)))
             ])
         return cb
 
@@ -339,8 +365,9 @@ def _load_hf_params_streamed(
 
     for key, sd in shapes["layers"].items():
         template, transpose = _LAYER_MAP[key]
-        cb = expert_cb(template, transpose) if "{e}" in template \
-            else stacked_cb(template, transpose)
+        ids = _layer_ids_for(cfg, key)
+        cb = expert_cb(template, transpose, ids) if "{e}" in template \
+            else stacked_cb(template, transpose, ids)
         params["layers"][key] = leaf_from_callback(
             sd.shape, shardings["layers"][key], cb
         )
@@ -372,7 +399,9 @@ def save_hf_params(
 
     if dtype not in ("float32", "bfloat16"):
         raise ValueError(f"dtype must be float32|bfloat16, got {dtype!r}")
-    n_stacked = next(iter(params["layers"].values())).shape[0]
+    # anchor the padding check on an all-layers key: interleaved MoE trees
+    # legitimately stack MLP/expert keys over layer SUBSETS
+    n_stacked = params["layers"]["input_layernorm"].shape[0]
     if n_stacked != cfg.num_hidden_layers:
         # Uneven-PP trees carry identity padding slots at stage boundaries
         # (pipeline_parallel.pad_stacked_params); the pad layout depends on
@@ -402,12 +431,19 @@ def save_hf_params(
         plan(*_TOP_MAP["lm_head"], params["lm_head"])
     for key, stacked in params["layers"].items():
         template, transpose = _LAYER_MAP[key]
-        for i in range(stacked.shape[0]):
+        ids = _layer_ids_for(cfg, key)
+        if len(ids) != stacked.shape[0]:
+            raise ValueError(
+                f"layers[{key!r}] stacks {stacked.shape[0]} rows but the "
+                f"config maps it to {len(ids)} layers "
+                "(mlp_only_layers/decoder_sparse_step mismatch?)"
+            )
+        for r in range(stacked.shape[0]):
             if "{e}" in template:
                 for e in range(stacked.shape[1]):
-                    plan(template, transpose, stacked, (i, e), i=i, e=e)
+                    plan(template, transpose, stacked, (r, e), i=ids[r], e=e)
             else:
-                plan(template, transpose, stacked, (i,), i=i)
+                plan(template, transpose, stacked, (r,), i=ids[r])
 
     nbytes = {
         name: int(np.prod(leaf.shape[len(idx):])) * esize

@@ -9,6 +9,8 @@ skips slots that are zero anyway.
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -89,8 +91,20 @@ class TestKernelParity:
 
 
 class TestMoEForwardToggle:
+    def test_env_is_config_default_at_construction(self, monkeypatch):
+        from scaletorch_tpu.models.qwen3_moe import Qwen3MoEConfig
+
+        monkeypatch.setenv("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "1")
+        assert Qwen3MoEConfig().use_grouped_mlp_kernel is True
+        monkeypatch.setenv("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "0")
+        assert Qwen3MoEConfig().use_grouped_mlp_kernel is False
+        # post-construction env flips don't reach an existing config
+        cfg = Qwen3MoEConfig()
+        monkeypatch.setenv("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "1")
+        assert cfg.use_grouped_mlp_kernel is False
+
     @pytest.mark.parametrize("ep", [1, 2])
-    def test_kernel_path_matches_einsum_path(self, monkeypatch, ep):
+    def test_kernel_path_matches_einsum_path(self, ep):
         from scaletorch_tpu.models.qwen3_moe import (
             Qwen3MoEConfig,
             forward,
@@ -111,16 +125,18 @@ class TestMoEForwardToggle:
 
         outs = {}
         for mode in ("einsum", "kernel"):
-            monkeypatch.setenv("SCALETORCH_TPU_GROUPED_MLP_KERNEL",
-                               "1" if mode == "kernel" else "0")
+            # the toggle is a CONFIG field (resolved from the env once at
+            # construction) so two settings can trace in one process
+            mcfg = dataclasses.replace(
+                cfg, use_grouped_mlp_kernel=(mode == "kernel"))
             if ep == 1:
-                outs[mode] = forward(params, ids, cfg)
+                outs[mode] = forward(params, ids, mcfg)
             else:
                 mm = MeshManager(ep=ep, dp=8 // ep)
                 specs = qwen3_moe_param_specs(cfg, tp_axis="tp", ep_axis="ep")
 
                 def f(p, i):
-                    out = forward(p, i, cfg, ep_axis="ep")
+                    out = forward(p, i, mcfg, ep_axis="ep")
                     # logits vary over (ep, tp) via the expert shards'
                     # spec; collapse the identical copies
                     return jax.lax.pmean(out, ("ep", "tp"))
