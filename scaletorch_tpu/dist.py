@@ -191,6 +191,101 @@ def barrier(name: str = "barrier") -> None:
         multihost_utils.sync_global_devices(name)
 
 
+# ---------------------------------------------------------------------------
+# Cross-process OBJECT collectives (reference dist/object_ops.py:26-318 +
+# gather_utils.py:24-211). Under single-controller SPMD most result
+# collection is moot — every process computes the same globals — but eval
+# loops that shard WORK across processes (per-process files, per-host
+# generation samples) still need to move arbitrary picklables. The wire
+# is pickled bytes -> padded uint8 arrays -> one device all-gather
+# (jax.experimental.multihost_utils), the exact role of the reference's
+# _object_to_tensor + all_gather (object_ops.py:26-44).
+# ---------------------------------------------------------------------------
+
+
+def _obj_to_u8(obj: Any) -> np.ndarray:
+    import pickle
+
+    return np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+
+
+def _u8_to_obj(buf: np.ndarray, size: int) -> Any:
+    import pickle
+
+    return pickle.loads(bytes(np.asarray(buf[:size], dtype=np.uint8)))
+
+
+def all_gather_object(obj: Any) -> list:
+    """Every process contributes one picklable; every process receives
+    ``[obj_0, ..., obj_{P-1}]`` in process order (reference
+    all_gather_object, object_ops.py:186-253)."""
+    if jax.process_count() == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    buf = _obj_to_u8(obj)
+    sizes = np.asarray(
+        multihost_utils.process_allgather(np.int64(buf.size)))
+    cap = int(sizes.max())
+    padded = np.zeros(cap, np.uint8)
+    padded[: buf.size] = buf
+    bufs = np.asarray(multihost_utils.process_allgather(padded))
+    return [_u8_to_obj(bufs[p], int(sizes[p]))
+            for p in range(jax.process_count())]
+
+
+def gather_object(obj: Any, dst: int = 0) -> Optional[list]:
+    """Gather picklables to process ``dst``; other processes return None
+    (reference gather_object, object_ops.py:256-318). The transport is an
+    all-gather (XLA collectives have no rooted object gather); only the
+    RESULT visibility is rooted, keeping the reference API."""
+    out = all_gather_object(obj)
+    return out if jax.process_index() == dst else None
+
+
+def broadcast_object_list(objs: list, src: int = 0) -> list:
+    """Replace every element with ``src``'s version (reference
+    broadcast_object_list, object_ops.py:117-183)."""
+    if jax.process_count() == 1:
+        return list(objs)
+    # only src's payload matters: non-src processes contribute a tiny
+    # placeholder so the padded all-gather moves src's bytes once, not
+    # every process's full copy
+    mine = list(objs) if jax.process_index() == src else None
+    gathered = all_gather_object(mine)
+    chosen = gathered[src]
+    if len(chosen) != len(objs):
+        raise ValueError(
+            f"broadcast_object_list: src={src} holds {len(chosen)} objects, "
+            f"this process expected {len(objs)}"
+        )
+    objs[:] = chosen
+    return objs
+
+
+def collect_results(results: list, size: int,
+                    device: str = "cpu") -> Optional[list]:
+    """Collect per-process result lists to process 0, round-robin
+    interleaved and truncated to ``size`` (reference collect_results,
+    gather_utils.py:24-211: rank r holds samples r, r+P, r+2P, ... of a
+    round-robin sharded eval set). Non-zero processes return None.
+
+    ``device`` is accepted for reference CLI parity; on TPU there is one
+    transport (the uint8 all-gather above), so the value is ignored.
+    """
+    del device  # single transport on TPU
+    parts = all_gather_object(list(results))
+    if jax.process_index() != 0:
+        return None
+    interleaved: list = []
+    longest = max((len(p) for p in parts), default=0)
+    for j in range(longest):
+        for p in parts:
+            if j < len(p):
+                interleaved.append(p[j])
+    return interleaved[:size]
+
+
 def put_global(host_array, sharding) -> jax.Array:
     """Materialise a global array from an identical host copy per process.
 

@@ -72,7 +72,23 @@ for _ in range(cfg.total_train_steps):
         trainer.params, trainer.opt_state, batch
     )
     losses.append(float(m["loss"]))
-print("RESULT " + json.dumps({"proc": jax.process_index(), "losses": losses}),
+
+# object collectives over the real 2-process cluster (reference
+# object_ops/gather_utils parity): arbitrary picklables, uneven sizes
+from scaletorch_tpu.dist import all_gather_object, collect_results
+me = jax.process_index()
+mine = {"proc": me, "payload": "x" * (10 + 100 * me), "nested": [me, {me: me}]}
+gathered = all_gather_object(mine)
+assert [g["proc"] for g in gathered] == [0, 1], gathered
+part = [f"s{me}", f"s{me + 2}"]  # round-robin shard of ['s0','s1','s2','s3']
+merged = collect_results(part, size=3)
+if me == 0:
+    assert merged == ["s0", "s1", "s2"], merged
+else:
+    assert merged is None, merged
+
+print("RESULT " + json.dumps({"proc": jax.process_index(), "losses": losses,
+                              "objects_ok": True}),
       flush=True)
 """
 

