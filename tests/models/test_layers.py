@@ -151,6 +151,7 @@ class TestSdpa:
             p /= p.sum()
             np.testing.assert_allclose(out[i], p @ vn[: i + 1], rtol=1e-5, atol=1e-6)
 
+    @pytest.mark.slow
     def test_gqa_matches_expanded(self):
         key = jax.random.PRNGKey(6)
         q = jax.random.normal(key, (2, 4, 5, 8))

@@ -62,6 +62,7 @@ class TestLlama:
         b = forward(params, ids, cfg, gradient_checkpointing=True)
         np.testing.assert_allclose(a, b, atol=1e-6)
 
+    @pytest.mark.slow
     def test_gradient_checkpointing_same_grads(self, tiny):
         cfg, params = tiny
         ids = jnp.arange(16, dtype=jnp.int32).reshape(2, 8)

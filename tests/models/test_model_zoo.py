@@ -45,6 +45,7 @@ class TestGPTMoE:
         assert np.isfinite(float(aux)) and float(aux) > 0
         assert np.all(np.isfinite(np.asarray(logits)))
 
+    @pytest.mark.slow
     def test_noisy_routing_changes_logits(self, setup):
         model, params, ids = setup
         det = model(params, ids)

@@ -103,6 +103,7 @@ class TestMoEForwardToggle:
         monkeypatch.setenv("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "1")
         assert cfg.use_grouped_mlp_kernel is False
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("ep", [1, 2])
     def test_kernel_path_matches_einsum_path(self, ep):
         from scaletorch_tpu.models.qwen3_moe import (

@@ -31,6 +31,7 @@ def make_qkv(hq=4, hkv=2, s=32, d=16, b=2, seed=0):
 
 
 class TestUlysses:
+    @pytest.mark.slow
     @pytest.mark.parametrize("cp,dp,hq,hkv", [(2, 4, 4, 2), (4, 2, 8, 4)])
     def test_forward_matches_sdpa(self, cp, dp, hq, hkv):
         q, k, v = make_qkv(hq=hq, hkv=hkv)
@@ -41,6 +42,8 @@ class TestUlysses:
             mesh=mm.mesh, in_specs=(QKV,) * 3, out_specs=QKV,
         )
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
+
+    @pytest.mark.slow
 
     def test_backward_matches_sdpa(self):
         q, k, v = make_qkv(hq=8, hkv=4)
@@ -61,6 +64,8 @@ class TestUlysses:
         for a, b in zip(g_ref, g):
             np.testing.assert_allclose(a, b, atol=1e-5)
 
+    @pytest.mark.slow
+
     def test_pallas_blocks_match(self):
         q, k, v = make_qkv(hq=4, hkv=2, s=64)
         ref = sdpa_attention(q, k, v, causal=True)
@@ -80,6 +85,8 @@ class TestUlysses:
                 lambda q, k, v: ulysses_attention(q, k, v, impl="xla"),
                 mesh=mm.mesh, in_specs=(QKV,) * 3, out_specs=QKV,
             )(q, k, v)
+
+    @pytest.mark.slow
 
     def test_trainer_ulysses_matches_dp_only_loss(self):
         """End-to-end: cp=2 Ulysses Trainer (contiguous layout, no host

@@ -29,6 +29,7 @@ def make_qkv(hq=4, hkv=2, s=32, d=16, b=2, seed=0):
 
 
 class TestRingAttention:
+    @pytest.mark.slow
     @pytest.mark.parametrize("cp,dp", [(2, 4), (4, 2), (8, 1)])
     def test_forward_matches_sdpa(self, cp, dp):
         q, k, v = make_qkv()
@@ -39,6 +40,8 @@ class TestRingAttention:
             mesh=mm.mesh, in_specs=(QKV_SPEC,) * 3, out_specs=QKV_SPEC,
         )
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
+
+    @pytest.mark.slow
 
     def test_backward_matches_sdpa(self):
         q, k, v = make_qkv()
@@ -59,6 +62,8 @@ class TestRingAttention:
         for a, b in zip(g_ref, g):
             np.testing.assert_allclose(a, b, atol=5e-6)
 
+    @pytest.mark.slow
+
     def test_mha_no_gqa(self):
         q, k, v = make_qkv(hq=4, hkv=4)
         ref = sdpa_attention(q, k, v, causal=True)
@@ -69,6 +74,7 @@ class TestRingAttention:
         )
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("cp,dp", [(2, 4), (4, 2)])
     def test_pallas_forward_matches_sdpa(self, cp, dp):
         """Flash-kernel blocks inside the ring (interpret mode on CPU)."""
@@ -81,6 +87,8 @@ class TestRingAttention:
             mesh=mm.mesh, in_specs=(QKV_SPEC,) * 3, out_specs=QKV_SPEC,
         )
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
+
+    @pytest.mark.slow
 
     def test_pallas_backward_matches_sdpa(self):
         q, k, v = make_qkv()
@@ -125,6 +133,7 @@ class TestZigzagRingAttention:
         order = zigzag_order(s, cp)
         return [np.asarray(a)[:, :, order] for a in arrs]
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("cp,dp,impl,interp", [
         (2, 4, "xla", False), (4, 2, "xla", False),
         (2, 4, "pallas", True), (4, 2, "pallas", True),
@@ -145,6 +154,7 @@ class TestZigzagRingAttention:
         out = np.asarray(f(qz, kz, vz))[:, :, zigzag_restore(s, cp)]
         np.testing.assert_allclose(out, ref, atol=2e-5)
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("cp,dp,impl,interp", [
         (4, 2, "xla", False), (4, 2, "pallas", True),
     ])
@@ -209,6 +219,7 @@ class TestZigzagRingAttention:
                 mesh=mm.mesh, in_specs=(QKV_SPEC,) * 3, out_specs=QKV_SPEC,
             )(q, k, v)
 
+    @pytest.mark.slow
     def test_contiguous_trainer_unaffected_by_zigzag_env(self, monkeypatch):
         """The layout must be pinned into each step from ITS config at
         build time: a contiguous Trainer constructed before a zigzag one
@@ -249,6 +260,7 @@ class TestZigzagRingAttention:
         # shards and corrupt the loss
         assert losses["contig"] == pytest.approx(losses["dp8"], rel=2e-4)
 
+    @pytest.mark.slow
     def test_trainer_zigzag_matches_dp_only_loss(self, monkeypatch):
         """End-to-end: a cp=2 zigzag Trainer (pinned backend alias + host
         batch permutation + ring schedule) reproduces the dp-only loss —
@@ -280,6 +292,7 @@ class TestZigzagRingAttention:
         assert losses["zz"] == pytest.approx(losses["dp8"], rel=2e-4)
 
 
+@pytest.mark.slow
 class TestCpModelParity:
     def test_cp_forward_matches_dense(self):
         """Full decoder under cp=2 x tp=2 (+SP) vs single-device: the model

@@ -64,6 +64,7 @@ class TestSpecs:
 
 
 class TestFsdpTraining:
+    @pytest.mark.slow
     def test_matches_replicated_and_shards_storage(self):
         params_host = init_params(jax.random.key(0), CFG)
 
@@ -105,6 +106,7 @@ class TestFsdpTraining:
         ]
         assert big_state_fracs and max(big_state_fracs) <= 1 / n_dev + 1e-9
 
+    @pytest.mark.slow
     def test_bf16_params_supported(self):
         cfg16 = LlamaConfig(**{**CFG.__dict__, "dtype": jnp.bfloat16,
                                "param_dtype": jnp.bfloat16})

@@ -126,6 +126,7 @@ def setup():
     return params, ids, targets, loss, grads
 
 
+@pytest.mark.slow
 class TestPipelineNumerics:
     @pytest.mark.parametrize("pp", [2, 4])
     def test_pp_matches_single_device(self, setup, pp):
@@ -170,6 +171,7 @@ class TestPipelineNumerics:
         np.testing.assert_allclose(float(loss), float(ref_loss), rtol=2e-5)
 
 
+@pytest.mark.slow
 class TestPipelineTrainStep:
     @pytest.mark.parametrize("schedule", ["afab", "1f1b"])
     def test_spmd_step_with_pp(self, schedule):
@@ -254,6 +256,7 @@ class TestPipelineTrainStep:
         )
 
 
+@pytest.mark.slow
 class TestUnevenPipeline:
     """Uneven layer counts: pad the stacked axis, mask identity slots
     (reference PipelineParallel ragged stage counts,
@@ -361,6 +364,7 @@ class TestUnevenPipeline:
         assert losses["pp2"] == pytest.approx(losses["pp1"], rel=2e-4)
 
 
+@pytest.mark.slow
 class TestCustomPipelineProtocol:
     def test_custom_family_runs_pp_via_pipeline_spmd_loss(self):
         """The documented custom-model PP hook: a caller-supplied
@@ -433,6 +437,7 @@ class TestCustomPipelineProtocol:
             )
 
 
+@pytest.mark.slow
 class TestUnevenMoEPipeline:
     def test_uneven_moe_pp_step_matches_single_device(self):
         """PP x EP with a ragged layer split (L=3, pp=2): the MoE stack's

@@ -96,6 +96,7 @@ class TestParallelLayers:
         assert float(f(logits, targets)) == pytest.approx(float(ref), rel=1e-5)
 
 
+@pytest.mark.slow
 class TestTpModelParity:
     @pytest.mark.parametrize("sp", [False, True], ids=["tp", "tp_sp"])
     def test_forward_matches_single_device(self, setup, sp):
@@ -143,6 +144,7 @@ class TestTpModelParity:
         )
         np.testing.assert_allclose(f(params, ids), ref, atol=3e-5)
 
+    @pytest.mark.slow
     def test_grads_match_single_device(self, setup):
         params, ids, targets, _ = setup
         mm = MeshManager(tp=4, dp=2)
@@ -174,6 +176,7 @@ class TestValidation:
             )
 
 
+@pytest.mark.slow
 class TestSpmdTrainStep:
     def test_dp_tp_sp_step_matches_single_device(self, setup):
         import copy

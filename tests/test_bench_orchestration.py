@@ -121,6 +121,7 @@ def test_sdpa_kept_when_pallas_slower(fake_bench, capsys):
     assert line["pallas_mfu"] == 40.0
 
 
+@pytest.mark.slow
 def test_preflight_wedge_still_reports_banked_row(fake_bench, capsys):
     """The round-2 failure shape: the Pallas path wedges ignoring SIGINT.
     The banked SDPA number must still be the stdout line."""
@@ -131,6 +132,7 @@ def test_preflight_wedge_still_reports_banked_row(fake_bench, capsys):
     assert "budget" in line["pallas_skipped"]
 
 
+@pytest.mark.slow
 def test_pallas_row_hang_still_reports_banked_row(fake_bench, capsys):
     fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
                preflight="ok", pallas_row="hang")
@@ -140,6 +142,7 @@ def test_pallas_row_hang_still_reports_banked_row(fake_bench, capsys):
     assert "pallas_skipped" in line
 
 
+@pytest.mark.slow
 def test_result_kept_when_child_stalls_in_teardown(fake_bench, capsys):
     """A child that printed its measurement but stalled in PJRT-client
     teardown still counts: the number is real, only the exit was late."""
@@ -150,6 +153,7 @@ def test_result_kept_when_child_stalls_in_teardown(fake_bench, capsys):
     assert line["late_exit"] is True
 
 
+@pytest.mark.slow
 def test_wedged_banked_child_skips_the_pallas_experiment(fake_bench, capsys):
     """A result-then-wedge child holds the chip: the banked number is
     reported but NO further device subprocess may be launched at it."""
@@ -220,6 +224,7 @@ def test_extra_rows_fill_remaining_budget(fake_bench, capsys, monkeypatch):
     assert line["rows_measured"] == len(table)
 
 
+@pytest.mark.slow
 def test_extra_rows_stop_after_a_timeout(fake_bench, capsys, monkeypatch):
     """A row that exceeds its budget ends phase 3 — the tail of the
     window must not be burned on a sick chip — and the headline line

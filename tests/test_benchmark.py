@@ -38,6 +38,7 @@ def test_all_presets_build_valid_configs(name):
     make_bench_args(name, seq=256)
 
 
+@pytest.mark.slow
 def test_benchmark_config_runs_on_mesh(devices8):
     cfg = make_bench_args(
         "dense-tiny", seq=128, dp=8, micro_bs=1, dtype="float32",

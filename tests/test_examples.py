@@ -12,6 +12,9 @@ import sys
 
 import pytest
 
+# Heavyweight end-to-end tier (VERDICT r3 weak #7): full runs, not CI units
+pytestmark = pytest.mark.slow
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 

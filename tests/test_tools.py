@@ -16,6 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 
+@pytest.mark.slow
 def test_verify_weights_synthetic_self_test(capsys):
     from tools.verify_weights import synthetic_self_test
 
@@ -70,6 +71,7 @@ def test_group_hosts_slice_major_ranks():
     assert gh.group_hosts(gh.render(groups).splitlines()) == groups
 
 
+@pytest.mark.slow
 def test_bench_cp_compare_mechanics(tmp_path):
     """All three CP strategies run at one geometry and produce the same
     loss (exact attention each way); speedups are emitted. CPU-mesh

@@ -43,6 +43,7 @@ def _batch(accum=2, rows=4, seq=16, seed=0):
 
 
 @pytest.mark.parametrize("dims", [dict(dp=4, tp=2), dict(pp=2, dp=2, tp=2)])
+@pytest.mark.slow
 def test_eval_step_matches_train_loss(dims):
     mm = MeshManager(**dims)
     params = init_params(jax.random.PRNGKey(0), CFG)
@@ -65,6 +66,7 @@ def test_eval_step_matches_train_loss(dims):
     assert val == pytest.approx(float(metrics["loss"]), rel=1e-5)
 
 
+@pytest.mark.slow
 def test_trainer_evaluate_synthetic():
     cfg = ScaleTorchTPUArguments(
         model_type="llama", hidden_size=32, intermediate_size=64,
@@ -86,6 +88,7 @@ def test_trainer_evaluate_synthetic():
     tr.train(num_steps=1)
 
 
+@pytest.mark.slow
 def test_trainer_bf16_master_weights():
     """param_dtype=bfloat16 (torch-parity memory mode, bench 1.7B/4B rows):
     params AND adam moments stay bf16 across jitted steps — a dtype drift

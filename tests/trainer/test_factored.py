@@ -62,6 +62,7 @@ class TestShardedParity:
     def mesh(self):
         return Mesh(np.array(jax.devices()[:2]), ("tp",))
 
+    @pytest.mark.slow
     def test_tp2_updates_match_unsharded(self, mesh):
         params = _params()
         specs = {"colw": P(None, "tp"), "roww": P("tp", None),
@@ -124,6 +125,7 @@ class TestShardedParity:
 
 
 class TestTrainerIntegration:
+    @pytest.mark.slow
     def test_spmd_step_with_adafactor_tp2(self):
         """End-to-end: Trainer with optimizer_name=adafactor on a tp2xdp4
         mesh trains without NaN and keeps the factored state sharded."""

@@ -36,6 +36,7 @@ def params():
 
 
 class TestGradAccumulation:
+    @pytest.mark.slow
     def test_accum_equals_big_batch(self, params):
         """no_sync contract: accumulating 4 microbatches of 1 == one
         microbatch of 4 (loss is a token mean; equal-size microbatches)."""
@@ -123,6 +124,7 @@ class TestOptimizers:
             create_optimizer(args)
 
 
+@pytest.mark.slow
 def test_uneven_pp_checkpoint_resume(tmp_path):
     """Save/resume with a PADDED uneven-PP layer stack: the orbax tree
     round-trips the padded layout and the resumed run continues exactly
