@@ -92,6 +92,14 @@ class ModelArguments:
     moe_capacity_factor: float = 1.25
     router_aux_loss_coef: float = 0.001
     router_z_loss_coef: float = 0.0
+    moe_dispatch: str = field(
+        default="auto",
+        metadata={"help": "auto | einsum | index — capacity-dispatch token "
+                          "movement. einsum = GShard one-hot (dense MXU, "
+                          "O(N·E·C·H)); index = scatter/gather of the "
+                          "O(N·k·H) moving rows (wins at large E). auto "
+                          "picks index once num_experts > 16."},
+    )
 
 
 @dataclass

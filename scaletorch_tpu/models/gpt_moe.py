@@ -25,10 +25,10 @@ import jax.numpy as jnp
 
 from scaletorch_tpu.models.layers import normal_init, sdpa_attention
 from scaletorch_tpu.parallel.expert_parallel import (
-    dispatch_tokens,
+    combine_routed,
+    dispatch_routed,
     expert_capacity,
-    gather_tokens,
-    top_k_routing,
+    route_tokens,
 )
 
 Params = Dict[str, Any]
@@ -52,7 +52,15 @@ class GPTMoEConfig:
     z_loss_weight: float = 0.001
     router_noise_std: float = 1.0  # noisy top-k (moe.py noisy routing)
     norm_topk_prob: bool = True
+    # einsum | index token movement (see expert_parallel.route_tokens);
+    # auto picks index once num_experts > 16, like Qwen3MoEConfig
+    moe_dispatch: str = "auto"
     dtype: Any = jnp.float32
+
+    def resolved_moe_dispatch(self) -> str:
+        if self.moe_dispatch != "auto":
+            return self.moe_dispatch
+        return "index" if self.num_experts > 16 else "einsum"
 
     @property
     def head_dim(self) -> int:
@@ -118,18 +126,24 @@ def _moe_ffn(
         noise = jax.random.normal(noise_key, logits.shape)
         logits = logits + cfg.router_noise_std * noise_scale * noise
     cap = expert_capacity(s, cfg.num_experts, cfg.top_k, cfg.capacity_factor)
-    dispatch, combine, aux = jax.vmap(
-        lambda lg: top_k_routing(
-            lg, cfg.top_k, cap, normalize_weights=cfg.norm_topk_prob
+    mode = cfg.resolved_moe_dispatch()
+    state, aux = jax.vmap(
+        lambda lg: route_tokens(
+            lg, cfg.top_k, cap, mode=mode,
+            normalize_weights=cfg.norm_topk_prob,
         )
     )(logits)
-    slots = dispatch_tokens(h, dispatch, axis=ep_axis)
+    slots = dispatch_routed(h, state, mode=mode,
+                            num_experts=cfg.num_experts, capacity=cap,
+                            axis=ep_axis)
     act = jax.nn.gelu(
         jnp.einsum("eth,ehi->eti", slots, layer["expert_fc"].astype(h.dtype))
     )
     out = jnp.einsum("eti,eih->eth", act,
                      layer["expert_proj"].astype(h.dtype))
-    y = gather_tokens(out, combine, axis=ep_axis)
+    y = combine_routed(out, state, mode=mode,
+                       num_experts=cfg.num_experts, capacity=cap,
+                       axis=ep_axis)
     aux_loss = (
         cfg.aux_loss_weight * jnp.mean(aux["aux_loss"])
         + cfg.z_loss_weight * jnp.mean(aux["z_loss"])

@@ -37,11 +37,8 @@ from scaletorch_tpu.models.llama import Params
 from scaletorch_tpu.models.qwen3 import Qwen3Config
 from scaletorch_tpu.models.registry import get_attention_backend
 from scaletorch_tpu.parallel.expert_parallel import (
-    dispatch_tokens,
     expert_capacity,
-    gather_tokens,
     moe_mlp,
-    top_k_routing,
 )
 from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
@@ -69,6 +66,13 @@ class Qwen3MoEConfig(Qwen3Config):
     # != 0 — the exact HF predicate (modeling_qwen3_moe.Qwen3MoeDecoderLayer).
     mlp_only_layers: Tuple[int, ...] = ()
     decoder_sparse_step: int = 1
+    # Token-movement implementation for the capacity dispatch. 'einsum' =
+    # GShard one-hot einsums (dense MXU work, O(N·E·C·H) MACs — fine at
+    # small E); 'index' = scatter/gather of exactly the O(N·k·H) moving
+    # rows (at Qwen3-30B-A3B scale, E=128/top-8, the one-hot einsums cost
+    # ~4.5x the expert matmuls themselves). 'auto' picks 'index' once
+    # E > 16. Both compute identical math (same drops, same weights).
+    moe_dispatch: str = "auto"
     # Slot-skipping Pallas expert kernel (ops/pallas/grouped_mlp.py). The
     # env toggle is read ONCE, at config construction (host side) — never
     # at trace time inside the jitted model, so two models with different
@@ -81,6 +85,11 @@ class Qwen3MoEConfig(Qwen3Config):
         # frozen dataclass: coerce a list argument to a hashable tuple
         object.__setattr__(self, "mlp_only_layers",
                            tuple(self.mlp_only_layers))
+        if self.moe_dispatch not in ("auto", "einsum", "index"):
+            raise ValueError(
+                f"moe_dispatch must be 'auto', 'einsum' or 'index', got "
+                f"{self.moe_dispatch!r}"
+            )
         if self.decoder_sparse_step < 1:
             raise ValueError(
                 f"decoder_sparse_step must be >= 1, got "
@@ -121,6 +130,11 @@ class Qwen3MoEConfig(Qwen3Config):
     @property
     def is_uniform_sparse(self) -> bool:
         return all(self.sparse_layout())
+
+    def resolved_moe_dispatch(self) -> str:
+        if self.moe_dispatch != "auto":
+            return self.moe_dispatch
+        return "index" if self.num_experts > 16 else "einsum"
 
     def sparse_layer_ids(self) -> Tuple[int, ...]:
         return tuple(i for i, s in enumerate(self.sparse_layout()) if s)
@@ -269,26 +283,42 @@ def moe_block(
     cap = expert_capacity(
         s, cfg.num_experts, cfg.num_experts_per_tok, cfg.capacity_factor
     )
-    dispatch, combine, aux = jax.vmap(
-        lambda lg: top_k_routing(
-            lg, cfg.num_experts_per_tok, cap,
+    # Mode-aware movement API (expert_parallel.route_tokens & co):
+    # 'einsum' = GShard one-hot, 'index' = O(N·k·H) scatter/gather —
+    # identical math; 'auto' resolves by expert count (the one-hot
+    # einsums dominate step FLOPs at large E — AOT_30B_A3B.json).
+    from scaletorch_tpu.parallel.expert_parallel import (
+        combine_routed,
+        dispatch_routed,
+        route_tokens,
+        routed_fill_counts,
+    )
+
+    mode = cfg.resolved_moe_dispatch()
+    state, aux = jax.vmap(
+        lambda lg: route_tokens(
+            lg, cfg.num_experts_per_tok, cap, mode=mode,
             normalize_weights=cfg.norm_topk_prob,
         )
     )(logits)
+    slots = dispatch_routed(
+        h_full, state, mode=mode, num_experts=cfg.num_experts,
+        capacity=cap, axis=ep_axis)
     aux = {k: jnp.mean(v, axis=0) for k, v in aux.items()}  # mean over groups
-    slots = dispatch_tokens(h_full, dispatch, axis=ep_axis)
     kernel_extra = {}
     if cfg.use_grouped_mlp_kernel:
         # slot-skipping expert kernel: per-(expert, group) fill counts
         # ride the same exchange layout as the slots
-        from scaletorch_tpu.ops.pallas.grouped_mlp import slot_fill_counts
         from scaletorch_tpu.parallel.expert_parallel import (
             exchange_slot_counts,
         )
 
         kernel_extra = dict(
             slot_counts=exchange_slot_counts(
-                slot_fill_counts(dispatch), ep_axis),
+                routed_fill_counts(state, mode=mode,
+                                   num_experts=cfg.num_experts,
+                                   capacity=cap),
+                ep_axis),
             capacity=cap,
         )
     out = moe_mlp(
@@ -301,7 +331,9 @@ def moe_block(
         reduce="none" if sequence_parallel else "sum",
         **kernel_extra,
     )
-    y = gather_tokens(out, combine, axis=ep_axis)  # [B, S, H]
+    y = combine_routed(
+        out, state, mode=mode, num_experts=cfg.num_experts,
+        capacity=cap, axis=ep_axis)  # [B, S, H]
     if sequence_parallel:
         # Expert outputs are still tp-partial (reduce='none'); complete the
         # sum with the reduce-scatter that re-enters the SP region — the

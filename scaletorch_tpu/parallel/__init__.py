@@ -23,9 +23,14 @@ from scaletorch_tpu.parallel.fsdp import (  # noqa: F401
     shard_params_fsdp,
 )
 from scaletorch_tpu.parallel.expert_parallel import (  # noqa: F401
+    combine_routed,
+    dispatch_routed,
+    route_tokens,
+    routed_fill_counts,
     sort_dispatch_tokens,
     sort_gather_tokens,
     sorted_moe_forward,
+    top_k_routing_indexed,
 )
 from scaletorch_tpu.parallel.zigzag import (  # noqa: F401
     zigzag_batch,

@@ -139,6 +139,42 @@ def top_k_routing(
     return dispatch, combine, aux
 
 
+def _exchange_to_experts(slots: jax.Array, axis: Optional[str]) -> jax.Array:
+    """[E, G·C, H] full-expert slabs -> [E_local, ep·G·C, H] on the rank
+    owning each expert (identity at axis=None — the world_size==1 no-op
+    contract of the reference collectives, collective_ops.py:137)."""
+    e, gc, h = slots.shape
+    if axis is None:
+        return slots
+    slots = pvary_missing(slots, axis)
+    ep = jax.lax.axis_size(axis)
+    e_local = e // ep
+    # [E, G·C, H] -> [ep, E_local, G·C, H]; exchange leading dim so each
+    # rank collects its own experts' slabs from every peer.
+    slots = slots.reshape(ep, e_local, gc, h)
+    slots = jax.lax.all_to_all(slots, axis, split_axis=0, concat_axis=0,
+                               tiled=False)  # [ep, E_local, G·C, H]
+    # merge (source_rank, slot) into one token dim per local expert
+    return slots.transpose(1, 0, 2, 3).reshape(e_local, ep * gc, h)
+
+
+def _exchange_from_experts(expert_out: jax.Array,
+                           axis: Optional[str]) -> jax.Array:
+    """Reverse of ``_exchange_to_experts``: [E_local, ep·G·C, H] back to
+    the source ranks' [E, G·C, H] slab layout."""
+    if axis is None:
+        return expert_out
+    expert_out = pvary_missing(expert_out, axis)
+    ep = jax.lax.axis_size(axis)
+    e_local = expert_out.shape[0]
+    gc = expert_out.shape[1] // ep
+    h = expert_out.shape[-1]
+    slots = expert_out.reshape(e_local, ep, gc, h).transpose(1, 0, 2, 3)
+    slots = jax.lax.all_to_all(slots, axis, split_axis=0, concat_axis=0,
+                               tiled=False)  # [ep, E_local, G·C, H]
+    return slots.reshape(ep * e_local, gc, h)
+
+
 def dispatch_tokens(
     x: jax.Array,
     dispatch: jax.Array,
@@ -157,24 +193,18 @@ def dispatch_tokens(
     TPU-native equivalent of the reference's argsort + variable-split
     all-to-all (ep_comms.py:41-133): the einsum IS the sort (dense,
     MXU-friendly) and the all_to_all moves equal-size [E_local, G·C] slabs.
+
+    COST NOTE: the one-hot einsum does O(N·E·C·H) MAC work — dominant
+    over the expert matmuls themselves once E·C >> k·3·I (measured: ~4.5x
+    the expert FLOPs at Qwen3-30B-A3B's E=128/top-8). Large-E configs
+    should route through ``dispatch_tokens_indexed`` (O(N·k·H) scatter),
+    which ``moe_block`` auto-selects.
     """
     if x.ndim == 2:
         x, dispatch = x[None], dispatch[None]
     slots = jnp.einsum("gnh,gnec->egch", x, dispatch.astype(x.dtype))
     e, g, c, h = slots.shape
-    slots = slots.reshape(e, g * c, h)  # [E, G·C, H]
-    if axis is None:
-        return slots
-    slots = pvary_missing(slots, axis)
-    ep = jax.lax.axis_size(axis)
-    e_local = e // ep
-    # [E, G·C, H] -> [ep, E_local, G·C, H]; exchange leading dim so each
-    # rank collects its own experts' slabs from every peer.
-    slots = slots.reshape(ep, e_local, g * c, h)
-    slots = jax.lax.all_to_all(slots, axis, split_axis=0, concat_axis=0,
-                               tiled=False)  # [ep, E_local, G·C, H]
-    # merge (source_rank, slot) into one token dim per local expert
-    return slots.transpose(1, 0, 2, 3).reshape(e_local, ep * g * c, h)
+    return _exchange_to_experts(slots.reshape(e, g * c, h), axis)
 
 
 def gather_tokens(
@@ -196,19 +226,223 @@ def gather_tokens(
     g, n, e, c = combine.shape
     combine = combine.astype(expert_out.dtype)
     if axis is not None:
-        expert_out = pvary_missing(expert_out, axis)
         combine = pvary_missing(combine, axis)
-        ep = jax.lax.axis_size(axis)
-        e_local = expert_out.shape[0]
-        slots = expert_out.reshape(e_local, ep, g * c, expert_out.shape[-1])
-        slots = slots.transpose(1, 0, 2, 3)
-        slots = jax.lax.all_to_all(slots, axis, split_axis=0, concat_axis=0,
-                                   tiled=False)  # [ep, E_local, G·C, H]
-        expert_out = slots.reshape(ep * e_local, g * c, expert_out.shape[-1])
+    expert_out = _exchange_from_experts(expert_out, axis)
     h = expert_out.shape[-1]
     slots = expert_out.reshape(e, g, c, h)  # [E, G, C, H]
     y = jnp.einsum("egch,gnec->gnh", slots, combine)
     return y if grouped else y[0]
+
+
+def top_k_routing_indexed(
+    router_logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    *,
+    normalize_weights: bool = True,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """Index-form of ``top_k_routing`` — identical routing decisions and
+    aux losses, WITHOUT materialising the [N, E, C] one-hot tensors.
+
+    Returns (routing, aux) with routing =
+      expert_idx [N, k] int32 — chosen expert per (token, choice)
+      slot       [N, k] int32 — capacity-queue position; >= capacity means
+                                the choice was dropped
+      weight     [N, k] f32   — gating weight, already zeroed for drops
+
+    Why this exists: the one-hot dispatch/combine einsums cost
+    O(N·E·C·H) MACs and O(N·E·C) memory — at large expert counts
+    (Qwen3-30B-A3B: E=128, top-8, cf 1.25) that is ~4.5x the FLOPs of the
+    expert matmuls themselves. The index form scatters/gathers exactly
+    the O(N·k·H) rows that move. Same math, same drops, same aux.
+    """
+    n, e = router_logits.shape
+    logits32 = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits32, axis=-1)
+    gate_w, gate_idx = jax.lax.top_k(probs, top_k)
+    if normalize_weights:
+        gate_w = gate_w / jnp.sum(gate_w, axis=-1, keepdims=True)
+
+    onehot = (gate_idx[..., None] == jnp.arange(e)).astype(jnp.int32)
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
+    position_in_expert = jnp.cumsum(flat, axis=0) - flat
+    pos = jnp.sum(position_in_expert * flat, axis=-1)
+    pos = pos.reshape(top_k, n).transpose(1, 0)  # [N, k]
+    kept = pos < capacity
+
+    f = jnp.mean(jnp.sum(onehot.astype(jnp.float32), axis=1), axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = {
+        "aux_loss": e * jnp.sum(f * p) / top_k,
+        "z_loss": jnp.mean(jnp.square(jax.nn.logsumexp(logits32, axis=-1))),
+        "expert_load": f,
+        "dropped_fraction": 1.0 - jnp.sum(kept) / (n * top_k),
+    }
+    routing = {
+        "expert_idx": gate_idx.astype(jnp.int32),
+        "slot": pos.astype(jnp.int32),
+        "weight": jnp.where(kept, gate_w, 0.0),
+    }
+    return routing, aux
+
+
+def dispatch_tokens_indexed(
+    x: jax.Array,
+    routing: Dict[str, jax.Array],
+    *,
+    num_experts: int,
+    capacity: int,
+    axis: Optional[str] = None,
+) -> jax.Array:
+    """Index-based counterpart of ``dispatch_tokens``: scatter each kept
+    (token, choice) row into its [E, G, C, H] capacity slot — O(N·k·H)
+    moved rows instead of the one-hot's O(N·E·C·H) einsum — then ride the
+    same equal-slab ``all_to_all``. Output layout is identical to
+    ``dispatch_tokens`` ([E_local, ep·G·C, H] / [E, G·C, H]), so
+    ``moe_mlp`` and the grouped Pallas kernel are path-agnostic.
+
+    x: [N, H] or [G, N, H]; routing leaves [N, k] or [G, N, k].
+    """
+    if x.ndim == 2:
+        x = x[None]
+        routing = {k: v[None] for k, v in routing.items()}
+    g, n, h = x.shape
+    k = routing["expert_idx"].shape[-1]
+    gi = jnp.broadcast_to(jnp.arange(g)[:, None, None], (g, n, k))
+    ni = jnp.broadcast_to(jnp.arange(n)[None, :, None], (g, n, k))
+    # rows past capacity carry slot >= C: mode='drop' discards them, which
+    # IS the capacity-drop semantics (residual passes those tokens through)
+    slots = jnp.zeros((num_experts, g, capacity, h), x.dtype).at[
+        routing["expert_idx"].reshape(-1),
+        gi.reshape(-1),
+        routing["slot"].reshape(-1),
+    ].set(x[gi.reshape(-1), ni.reshape(-1)], mode="drop")
+    slots = slots.reshape(num_experts, g * capacity, h)
+    return _exchange_to_experts(slots, axis)
+
+
+def gather_tokens_indexed(
+    expert_out: jax.Array,
+    routing: Dict[str, jax.Array],
+    *,
+    num_experts: int,
+    capacity: int,
+    axis: Optional[str] = None,
+) -> jax.Array:
+    """Index-based counterpart of ``gather_tokens``: bring expert outputs
+    home over the reverse ``all_to_all``, then gather each (token, choice)
+    slot and take the weight-combined top-k sum — O(N·k·H) gathered rows.
+    Dropped choices contribute zero (their weight is zeroed in routing).
+    """
+    grouped = routing["expert_idx"].ndim == 3
+    if not grouped:
+        routing = {k: v[None] for k, v in routing.items()}
+    if axis is not None:
+        routing = {k: pvary_missing(v, axis) for k, v in routing.items()}
+    expert_out = _exchange_from_experts(expert_out, axis)
+    h = expert_out.shape[-1]
+    g, n, k = routing["expert_idx"].shape
+    slots = expert_out.reshape(num_experts, g, capacity, h)
+    gi = jnp.broadcast_to(jnp.arange(g)[:, None, None], (g, n, k))
+    safe_slot = jnp.minimum(routing["slot"], capacity - 1)
+    vals = slots[routing["expert_idx"], gi, safe_slot]  # [G, N, k, H]
+    w = routing["weight"].astype(expert_out.dtype)[..., None]
+    y = jnp.sum(w * vals, axis=2)  # [G, N, H]
+    return y if grouped else y[0]
+
+
+# ---------------------------------------------------------------------------
+# Mode-aware wrappers: ONE dispatch API over the einsum/index forms, so
+# every MoE model (qwen3_moe.moe_block, gpt_moe, custom families) is
+# movement-implementation-agnostic. ``state`` is a dict of arrays either
+# way (vmap/pytree friendly); ``mode`` stays a static kwarg.
+# ---------------------------------------------------------------------------
+
+
+def route_tokens(
+    router_logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    *,
+    mode: str,
+    normalize_weights: bool = True,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """(state, aux) for ``mode`` in {'einsum', 'index'} — identical routing
+    decisions, drops, and aux losses in both forms."""
+    if mode == "index":
+        return top_k_routing_indexed(
+            router_logits, top_k, capacity,
+            normalize_weights=normalize_weights)
+    dispatch, combine, aux = top_k_routing(
+        router_logits, top_k, capacity, normalize_weights=normalize_weights)
+    return {"dispatch": dispatch, "combine": combine}, aux
+
+
+def dispatch_routed(
+    x: jax.Array,
+    state: Dict[str, jax.Array],
+    *,
+    mode: str,
+    num_experts: int,
+    capacity: int,
+    axis: Optional[str] = None,
+) -> jax.Array:
+    """Move tokens to their experts under ``state`` from ``route_tokens``.
+    Output layout is identical for both modes ([E_local, ep·G·C, H])."""
+    if mode == "index":
+        return dispatch_tokens_indexed(
+            x, state, num_experts=num_experts, capacity=capacity, axis=axis)
+    return dispatch_tokens(x, state["dispatch"], axis=axis)
+
+
+def combine_routed(
+    expert_out: jax.Array,
+    state: Dict[str, jax.Array],
+    *,
+    mode: str,
+    num_experts: int,
+    capacity: int,
+    axis: Optional[str] = None,
+) -> jax.Array:
+    """Bring expert outputs home and take the weighted top-k sum."""
+    if mode == "index":
+        return gather_tokens_indexed(
+            expert_out, state, num_experts=num_experts, capacity=capacity,
+            axis=axis)
+    return gather_tokens(expert_out, state["combine"], axis=axis)
+
+
+def routed_fill_counts(
+    state: Dict[str, jax.Array],
+    *,
+    mode: str,
+    num_experts: int,
+    capacity: int,
+) -> jax.Array:
+    """[E, G] per-(expert, group) fill counts for the slot-skipping
+    grouped kernel, from either state form."""
+    if mode == "index":
+        return slot_fill_counts_indexed(state, num_experts, capacity)
+    from scaletorch_tpu.ops.pallas.grouped_mlp import slot_fill_counts
+
+    return slot_fill_counts(state["dispatch"])
+
+
+def slot_fill_counts_indexed(
+    routing: Dict[str, jax.Array], num_experts: int, capacity: int
+) -> jax.Array:
+    """[E, G] int32 fill counts from index-form routing (the counterpart
+    of ops.pallas.grouped_mlp.slot_fill_counts for the one-hot form):
+    capacity dispatch fills each expert's slots as a prefix, so the count
+    is the number of kept (token, choice) rows per (expert, group)."""
+    ei = routing["expert_idx"]
+    if ei.ndim == 2:
+        ei, slot = ei[None], routing["slot"][None]
+    else:
+        slot = routing["slot"]
+    kept = slot < capacity
+    onehot = (ei[..., None] == jnp.arange(num_experts)) & kept[..., None]
+    return jnp.sum(onehot, axis=(1, 2)).astype(jnp.int32).T  # [E, G]
 
 
 def sorted_dispatch_reference(

@@ -46,6 +46,7 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
             return qwen3_moe.Qwen3MoEConfig.from_hf(
                 hf,
                 capacity_factor=cfg.moe_capacity_factor,
+                moe_dispatch=cfg.moe_dispatch,
                 aux_loss_coef=cfg.router_aux_loss_coef,
                 z_loss_coef=cfg.router_z_loss_coef,
                 **overrides,
@@ -76,6 +77,7 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
             moe_intermediate_size=cfg.moe_intermediate_size
             or (cfg.intermediate_size or 4 * cfg.hidden_size),
             capacity_factor=cfg.moe_capacity_factor,
+            moe_dispatch=cfg.moe_dispatch,
             aux_loss_coef=cfg.router_aux_loss_coef,
             z_loss_coef=cfg.router_z_loss_coef,
             **common,

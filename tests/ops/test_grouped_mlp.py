@@ -104,8 +104,12 @@ class TestMoEForwardToggle:
         assert cfg.use_grouped_mlp_kernel is False
 
     @pytest.mark.slow
+    @pytest.mark.parametrize("dispatch", ["einsum", "index"])
     @pytest.mark.parametrize("ep", [1, 2])
-    def test_kernel_path_matches_einsum_path(self, ep):
+    def test_kernel_path_matches_einsum_path(self, ep, dispatch):
+        """Kernel on/off parity under BOTH dispatch modes — 'index' is the
+        combination the flagship E=128 config auto-selects, where the
+        kernel's fill counts come from slot_fill_counts_indexed."""
         from scaletorch_tpu.models.qwen3_moe import (
             Qwen3MoEConfig,
             forward,
@@ -120,12 +124,13 @@ class TestMoEForwardToggle:
             num_attention_heads=4, num_key_value_heads=4, head_dim=8,
             num_experts=4, num_experts_per_tok=2, capacity_factor=1.25,
             dtype=jnp.float32, qk_norm=True, tie_word_embeddings=False,
+            moe_dispatch=dispatch,
         )
         params = init_params(jax.random.PRNGKey(0), cfg)
         ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 64)
 
         outs = {}
-        for mode in ("einsum", "kernel"):
+        for mode in ("plain", "kernel"):
             # the toggle is a CONFIG field (resolved from the env once at
             # construction) so two settings can trace in one process
             mcfg = dataclasses.replace(
@@ -145,5 +150,5 @@ class TestMoEForwardToggle:
                 outs[mode] = jax.shard_map(
                     f, mesh=mm.mesh, in_specs=(specs, P()), out_specs=P(),
                 )(params, ids)
-        np.testing.assert_allclose(outs["kernel"], outs["einsum"],
+        np.testing.assert_allclose(outs["kernel"], outs["plain"],
                                    atol=2e-5)

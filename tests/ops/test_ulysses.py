@@ -44,7 +44,6 @@ class TestUlysses:
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
 
     @pytest.mark.slow
-
     def test_backward_matches_sdpa(self):
         q, k, v = make_qkv(hq=8, hkv=4)
         do = jax.random.normal(jax.random.PRNGKey(3), q.shape)
@@ -65,7 +64,6 @@ class TestUlysses:
             np.testing.assert_allclose(a, b, atol=1e-5)
 
     @pytest.mark.slow
-
     def test_pallas_blocks_match(self):
         q, k, v = make_qkv(hq=4, hkv=2, s=64)
         ref = sdpa_attention(q, k, v, causal=True)
@@ -87,7 +85,6 @@ class TestUlysses:
             )(q, k, v)
 
     @pytest.mark.slow
-
     def test_trainer_ulysses_matches_dp_only_loss(self):
         """End-to-end: cp=2 Ulysses Trainer (contiguous layout, no host
         permutation) reproduces the dp-only loss."""

@@ -42,7 +42,6 @@ class TestRingAttention:
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
 
     @pytest.mark.slow
-
     def test_backward_matches_sdpa(self):
         q, k, v = make_qkv()
         do = jax.random.normal(jax.random.PRNGKey(3), q.shape)
@@ -63,7 +62,6 @@ class TestRingAttention:
             np.testing.assert_allclose(a, b, atol=5e-6)
 
     @pytest.mark.slow
-
     def test_mha_no_gqa(self):
         q, k, v = make_qkv(hq=4, hkv=4)
         ref = sdpa_attention(q, k, v, causal=True)
@@ -89,7 +87,6 @@ class TestRingAttention:
         np.testing.assert_allclose(f(q, k, v), ref, atol=2e-5)
 
     @pytest.mark.slow
-
     def test_pallas_backward_matches_sdpa(self):
         q, k, v = make_qkv()
         do = jax.random.normal(jax.random.PRNGKey(3), q.shape)

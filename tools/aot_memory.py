@@ -57,7 +57,8 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
                   gc: bool, remat_policy: str, gen: str,
                   param_dtype: str = "float32", optimizer: str = "adamw",
                   dp: int = 1, tp: int = 1, cp: int = 1, pp: int = 1,
-                  ep: int = 1, sp: bool = False, pp_engine: str = "afab"):
+                  ep: int = 1, sp: bool = False, pp_engine: str = "afab",
+                  moe_dispatch: str = "auto"):
     """Lower the real SPMD train step against an AOT TPU topology —
     single chip by default, or a multi-chip mesh factoring (dp/tp/cp/pp/
     ep over the 4-chip v5e host topology): Mosaic kernel compilation for
@@ -93,7 +94,8 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
                           dp=dp, tp=tp, cp=cp, pp=pp, ep=ep, sp=sp,
                           pp_engine=pp_engine,
                           extra={"param_dtype": param_dtype,
-                                 "optimizer_name": optimizer})
+                                 "optimizer_name": optimizer,
+                                 "moe_dispatch": moe_dispatch})
     model_cfg = build_model_config(cfg)
     mm = MeshManager(devices=list(topo.devices[:world]),
                      dp=dp, pp=pp, cp=cp, ep=ep, tp=tp)
@@ -161,7 +163,8 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
         gen=args_ns.gen, param_dtype=args_ns.param_dtype,
         optimizer=args_ns.optimizer,
         dp=args_ns.dp, tp=args_ns.tp, cp=args_ns.cp, pp=args_ns.pp,
-        ep=args_ns.ep, sp=args_ns.sp, pp_engine=args_ns.pp_engine)
+        ep=args_ns.ep, sp=args_ns.sp, pp_engine=args_ns.pp_engine,
+        moe_dispatch=args_ns.moe_dispatch)
     # XLA:TPU enforces the HBM budget at compile time (RESOURCE_EXHAUSTED
     # on overflow), so a successful compile IS the fit verdict — the
     # caller's except path records the failure. The size fields below are
@@ -171,7 +174,13 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
     m = compiled.memory_analysis()
     arg = m.argument_size_in_bytes
     peak = arg + m.temp_size_in_bytes + m.generated_code_size_in_bytes
+    try:
+        cost = compiled.cost_analysis() or {}
+        flops = cost.get("flops")
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        flops = None
     return {
+        **({"step_tflops": round(flops / 1e12, 2)} if flops else {}),
         "model": args_ns.model, "seq": args_ns.seq, "bs": args_ns.bs,
         "accum": args_ns.accum, "gc": gc, "remat_policy": remat_policy,
         "gen": args_ns.gen, "param_dtype": args_ns.param_dtype,
@@ -179,6 +188,8 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
            if getattr(args_ns, ax) > 1},
         **({"sp": True} if args_ns.sp else {}),
         **({"pp_engine": args_ns.pp_engine} if args_ns.pp > 1 else {}),
+        **({"moe_dispatch": args_ns.moe_dispatch}
+           if args_ns.moe_dispatch != "auto" else {}),
         "argument_gb": round(arg / 1e9, 3),
         "temp_gb": round(m.temp_size_in_bytes / 1e9, 3),
         "output_gb": round(m.output_size_in_bytes / 1e9, 3),
@@ -207,6 +218,9 @@ def main() -> None:
                     help="pipeline schedule to analyze (afab is the "
                          "config/train.py default; memory_chunked (alias 1f1b) is the O(pp)-memory "
                          "chunked schedule)")
+    ap.add_argument("--moe-dispatch", default="auto",
+                    choices=["auto", "einsum", "index"],
+                    help="capacity-dispatch token movement (MoE models)")
     ap.add_argument("--policies", nargs="*", default=None,
                     help="remat policies to compare (implies --gc)")
     ap.add_argument("--sweep-gc", action="store_true",
