@@ -28,6 +28,7 @@ from scaletorch_tpu.parallel.expert_parallel import (
     combine_routed,
     dispatch_routed,
     expert_capacity,
+    resolve_moe_dispatch,
     route_tokens,
 )
 
@@ -58,9 +59,9 @@ class GPTMoEConfig:
     dtype: Any = jnp.float32
 
     def resolved_moe_dispatch(self) -> str:
-        if self.moe_dispatch != "auto":
-            return self.moe_dispatch
-        return "index" if self.num_experts > 16 else "einsum"
+        # single source of truth for the auto crossover:
+        # expert_parallel.resolve_moe_dispatch
+        return resolve_moe_dispatch(self.moe_dispatch, self.num_experts)
 
     @property
     def head_dim(self) -> int:

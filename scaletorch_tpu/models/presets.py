@@ -91,6 +91,27 @@ MODEL_PRESETS: Dict[str, Dict[str, Any]] = {
         num_experts=128,
         num_experts_per_tok=8,
     ),
+    # Single-v5e-chip MoE (same shape family as qwen3-30b-a3b, scaled to
+    # fit 16 GB with bf16 master weights): E=64/top-8 keeps the
+    # large-expert-count dispatch regime where the index form wins
+    # (tools/bench_moe_dispatch.py measures it on-chip).
+    "moe-mid": dict(
+        model_type="qwen3_moe",
+        vocab_size=32768,
+        hidden_size=1024,
+        intermediate_size=3072,
+        moe_intermediate_size=384,
+        num_hidden_layers=12,
+        num_attention_heads=16,
+        num_key_value_heads=4,
+        head_dim=64,
+        rope_theta=1e6,
+        rms_norm_eps=1e-6,
+        max_position_embeddings=40960,
+        tie_word_embeddings=False,
+        num_experts=64,
+        num_experts_per_tok=8,
+    ),
     # Downscaled MoE for 8-chip correctness/system sweeps (same shape
     # family as qwen3-30b-a3b; fits a CPU-device mesh).
     "moe-tiny": dict(

@@ -37,8 +37,13 @@ from scaletorch_tpu.models.llama import Params
 from scaletorch_tpu.models.qwen3 import Qwen3Config
 from scaletorch_tpu.models.registry import get_attention_backend
 from scaletorch_tpu.parallel.expert_parallel import (
+    combine_routed,
+    dispatch_routed,
     expert_capacity,
     moe_mlp,
+    resolve_moe_dispatch,
+    route_tokens,
+    routed_fill_counts,
 )
 from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
@@ -132,9 +137,9 @@ class Qwen3MoEConfig(Qwen3Config):
         return all(self.sparse_layout())
 
     def resolved_moe_dispatch(self) -> str:
-        if self.moe_dispatch != "auto":
-            return self.moe_dispatch
-        return "index" if self.num_experts > 16 else "einsum"
+        # single source of truth for the auto crossover:
+        # expert_parallel.resolve_moe_dispatch
+        return resolve_moe_dispatch(self.moe_dispatch, self.num_experts)
 
     def sparse_layer_ids(self) -> Tuple[int, ...]:
         return tuple(i for i, s in enumerate(self.sparse_layout()) if s)
@@ -287,13 +292,6 @@ def moe_block(
     # 'einsum' = GShard one-hot, 'index' = O(N·k·H) scatter/gather —
     # identical math; 'auto' resolves by expert count (the one-hot
     # einsums dominate step FLOPs at large E — AOT_30B_A3B.json).
-    from scaletorch_tpu.parallel.expert_parallel import (
-        combine_routed,
-        dispatch_routed,
-        route_tokens,
-        routed_fill_counts,
-    )
-
     mode = cfg.resolved_moe_dispatch()
     state, aux = jax.vmap(
         lambda lg: route_tokens(

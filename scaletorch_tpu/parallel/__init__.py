@@ -25,6 +25,7 @@ from scaletorch_tpu.parallel.fsdp import (  # noqa: F401
 from scaletorch_tpu.parallel.expert_parallel import (  # noqa: F401
     combine_routed,
     dispatch_routed,
+    resolve_moe_dispatch,
     route_tokens,
     routed_fill_counts,
     sort_dispatch_tokens,

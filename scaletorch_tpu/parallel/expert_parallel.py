@@ -52,6 +52,51 @@ def expert_capacity(
     return max(1, min(c, num_tokens))
 
 
+def _route_core(
+    router_logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    normalize_weights: bool,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Dict[str, jax.Array]]:
+    """Shared routing math for BOTH dispatch forms — gate choice, capacity
+    queue position, drop mask, and aux losses. 'Identical math across
+    modes' is this module's load-bearing invariant; it lives in exactly
+    one place. Returns (gate_idx, gate_w, pos, kept, aux)."""
+    n, e = router_logits.shape
+    logits32 = router_logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits32, axis=-1)  # [N, E]
+    gate_w, gate_idx = jax.lax.top_k(probs, top_k)  # [N, k]
+    if normalize_weights:
+        gate_w = gate_w / jnp.sum(gate_w, axis=-1, keepdims=True)
+
+    # Position of each (token, choice) in its expert's queue: tokens are
+    # served in index order, choice-major (k-th choices queue after all
+    # (k-1)-th choices of earlier tokens — the Switch convention).
+    # Explicit iota==index one-hot instead of jax.nn.one_hot: the latter
+    # lowers through a closed_call whose MLIR lowering-cache entry goes
+    # missing when an interpret-mode pallas_call is lowered in the same
+    # program (the grouped-MLP kernel tests on CPU).
+    onehot = (gate_idx[..., None] == jnp.arange(e)).astype(jnp.int32)
+    # flatten choices to [k*N, E] in choice-major order so cumsum ranks
+    # first choices of all tokens before any second choice.
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
+    position_in_expert = jnp.cumsum(flat, axis=0) - flat  # [k*N, E]
+    pos = jnp.sum(position_in_expert * flat, axis=-1)  # [k*N]
+    pos = pos.reshape(top_k, n).transpose(1, 0)  # [N, k]
+    kept = pos < capacity
+
+    # Switch aux loss: E * sum_e f_e * P_e (pre-capacity assignment counts)
+    f = jnp.mean(jnp.sum(onehot.astype(jnp.float32), axis=1), axis=0)  # [E]
+    p = jnp.mean(probs, axis=0)  # [E]
+    aux = {
+        "aux_loss": e * jnp.sum(f * p) / top_k,
+        "z_loss": jnp.mean(jnp.square(jax.nn.logsumexp(logits32, axis=-1))),
+        "expert_load": f,
+        "dropped_fraction": 1.0 - jnp.sum(kept) / (n * top_k),
+    }
+    return gate_idx, gate_w, pos, kept, aux
+
+
 def top_k_routing(
     router_logits: jax.Array,
     top_k: int,
@@ -87,32 +132,14 @@ def top_k_routing(
     capacity-based MoE semantics (moe.py:510-600).
     """
     n, e = router_logits.shape
-    logits32 = router_logits.astype(jnp.float32)
-    probs = jax.nn.softmax(logits32, axis=-1)  # [N, E]
-    gate_w, gate_idx = jax.lax.top_k(probs, top_k)  # [N, k]
-    if normalize_weights:
-        gate_w = gate_w / jnp.sum(gate_w, axis=-1, keepdims=True)
+    gate_idx, gate_w, pos, kept, aux = _route_core(
+        router_logits, top_k, capacity, normalize_weights)
 
-    # Explicit iota==index one-hots instead of jax.nn.one_hot: the latter
-    # lowers through a closed_call whose MLIR lowering-cache entry goes
-    # missing when an interpret-mode pallas_call is lowered in the same
-    # program (the grouped-MLP kernel tests on CPU).
     def onehot_f(idx, depth):
         return (idx[..., None] == jnp.arange(depth)).astype(jnp.float32)
 
-    # Position of each (token, choice) in its expert's queue: tokens are
-    # served in index order, choice-major (k-th choices queue after all
-    # (k-1)-th choices of earlier tokens — the Switch convention).
-    onehot = onehot_f(gate_idx, e).astype(jnp.int32)  # [N, k, E]
-    # flatten choices to [k*N, E] in choice-major order so cumsum ranks
-    # first choices of all tokens before any second choice.
-    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
-    position_in_expert = jnp.cumsum(flat, axis=0) - flat  # [k*N, E]
-    pos = jnp.sum(position_in_expert * flat, axis=-1)  # [k*N]
-    pos = pos.reshape(top_k, n).transpose(1, 0)  # [N, k]
-    kept = pos < capacity
-
-    # dispatch/combine tensors
+    # dispatch/combine tensors (dropped choices map to a one-hot column
+    # at index `capacity`, which onehot_f truncates away)
     dispatch = (
         onehot_f(gate_idx, e)[..., None]
         * onehot_f(jnp.where(kept, pos, capacity), capacity)[:, :, None, :]
@@ -124,18 +151,6 @@ def top_k_routing(
     )  # [N, k, E]
     combine = jnp.einsum("nke,nkc->nec", combine,
                          onehot_f(jnp.where(kept, pos, capacity), capacity))
-
-    # Switch aux loss: E * sum_e f_e * P_e (pre-capacity assignment counts)
-    f = jnp.mean(jnp.sum(onehot.astype(jnp.float32), axis=1), axis=0)  # [E]
-    p = jnp.mean(probs, axis=0)  # [E]
-    aux_loss = e * jnp.sum(f * p) / top_k
-    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits32, axis=-1)))
-    aux = {
-        "aux_loss": aux_loss,
-        "z_loss": z_loss,
-        "expert_load": f,
-        "dropped_fraction": 1.0 - jnp.sum(kept) / (n * top_k),
-    }
     return dispatch, combine, aux
 
 
@@ -256,28 +271,8 @@ def top_k_routing_indexed(
     expert matmuls themselves. The index form scatters/gathers exactly
     the O(N·k·H) rows that move. Same math, same drops, same aux.
     """
-    n, e = router_logits.shape
-    logits32 = router_logits.astype(jnp.float32)
-    probs = jax.nn.softmax(logits32, axis=-1)
-    gate_w, gate_idx = jax.lax.top_k(probs, top_k)
-    if normalize_weights:
-        gate_w = gate_w / jnp.sum(gate_w, axis=-1, keepdims=True)
-
-    onehot = (gate_idx[..., None] == jnp.arange(e)).astype(jnp.int32)
-    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)
-    position_in_expert = jnp.cumsum(flat, axis=0) - flat
-    pos = jnp.sum(position_in_expert * flat, axis=-1)
-    pos = pos.reshape(top_k, n).transpose(1, 0)  # [N, k]
-    kept = pos < capacity
-
-    f = jnp.mean(jnp.sum(onehot.astype(jnp.float32), axis=1), axis=0)
-    p = jnp.mean(probs, axis=0)
-    aux = {
-        "aux_loss": e * jnp.sum(f * p) / top_k,
-        "z_loss": jnp.mean(jnp.square(jax.nn.logsumexp(logits32, axis=-1))),
-        "expert_load": f,
-        "dropped_fraction": 1.0 - jnp.sum(kept) / (n * top_k),
-    }
+    gate_idx, gate_w, pos, kept, aux = _route_core(
+        router_logits, top_k, capacity, normalize_weights)
     routing = {
         "expert_idx": gate_idx.astype(jnp.int32),
         "slot": pos.astype(jnp.int32),
@@ -359,6 +354,25 @@ def gather_tokens_indexed(
 # ---------------------------------------------------------------------------
 
 
+def resolve_moe_dispatch(mode: str, num_experts: int) -> str:
+    """'auto' -> the form that wins at this expert count. The crossover
+    is where the one-hot O(N·E·C·H) einsums start dominating the expert
+    matmuls (AOT cost analysis, AOT_30B_A3B.json; retune here — and only
+    here — after on-chip tools/bench_moe_dispatch.py measurements)."""
+    _check_mode(mode, allow_auto=True)
+    if mode != "auto":
+        return mode
+    return "index" if num_experts > 16 else "einsum"
+
+
+def _check_mode(mode: str, allow_auto: bool = False) -> None:
+    ok = ("auto", "einsum", "index") if allow_auto else ("einsum", "index")
+    if mode not in ok:
+        raise ValueError(
+            f"moe dispatch mode must be one of {ok}, got {mode!r}"
+        )
+
+
 def route_tokens(
     router_logits: jax.Array,
     top_k: int,
@@ -369,6 +383,7 @@ def route_tokens(
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """(state, aux) for ``mode`` in {'einsum', 'index'} — identical routing
     decisions, drops, and aux losses in both forms."""
+    _check_mode(mode)
     if mode == "index":
         return top_k_routing_indexed(
             router_logits, top_k, capacity,
@@ -389,6 +404,7 @@ def dispatch_routed(
 ) -> jax.Array:
     """Move tokens to their experts under ``state`` from ``route_tokens``.
     Output layout is identical for both modes ([E_local, ep·G·C, H])."""
+    _check_mode(mode)
     if mode == "index":
         return dispatch_tokens_indexed(
             x, state, num_experts=num_experts, capacity=capacity, axis=axis)
@@ -405,6 +421,7 @@ def combine_routed(
     axis: Optional[str] = None,
 ) -> jax.Array:
     """Bring expert outputs home and take the weighted top-k sum."""
+    _check_mode(mode)
     if mode == "index":
         return gather_tokens_indexed(
             expert_out, state, num_experts=num_experts, capacity=capacity,
@@ -421,6 +438,7 @@ def routed_fill_counts(
 ) -> jax.Array:
     """[E, G] per-(expert, group) fill counts for the slot-skipping
     grouped kernel, from either state form."""
+    _check_mode(mode)
     if mode == "index":
         return slot_fill_counts_indexed(state, num_experts, capacity)
     from scaletorch_tpu.ops.pallas.grouped_mlp import slot_fill_counts

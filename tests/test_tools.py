@@ -72,6 +72,34 @@ def test_group_hosts_slice_major_ranks():
 
 
 @pytest.mark.slow
+def test_bench_moe_dispatch_mechanics(tmp_path):
+    """Both dispatch modes run the same MoE geometry and produce the SAME
+    loss (identical routing math); the speedup field is emitted. CPU-mesh
+    numbers attest mechanics only (documented in the tool)."""
+    import json
+    import subprocess
+    import sys as _sys
+
+    out = tmp_path / "moe.json"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    r = subprocess.run(
+        [_sys.executable, os.path.join(REPO, "tools", "bench_moe_dispatch.py"),
+         "--cpu", "--model", "moe-tiny", "--ep", "2", "--dp", "2",
+         "--seq", "256", "--steps", "2", "--warmup", "1",
+         "--out", str(out)],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    data = json.loads(out.read_text())
+    for m in ("einsum", "index"):
+        assert "error" not in data[m], data[m]
+    assert data["index"]["loss"] == pytest.approx(
+        data["einsum"]["loss"], rel=2e-4)
+    assert "index_speedup_vs_einsum" in data
+
+
+@pytest.mark.slow
 def test_bench_cp_compare_mechanics(tmp_path):
     """All three CP strategies run at one geometry and produce the same
     loss (exact attention each way); speedups are emitted. CPU-mesh
