@@ -50,6 +50,9 @@ PP_SIZE=${PP_SIZE:-1}; CP_SIZE=${CP_SIZE:-1}
 # causal ring), ATTN_BACKEND=auto|ring|ulysses (ulysses = all-to-all
 # head-scatter; cp must divide kv heads)
 CP_LAYOUT=${CP_LAYOUT:-zigzag}; ATTN_BACKEND=${ATTN_BACKEND:-auto}
+# MoE knob: MOE_DISPATCH=auto|einsum|index (token-movement form; auto
+# picks index once num_experts > 16 — see AOT_30B_A3B.json)
+MOE_DISPATCH=${MOE_DISPATCH:-auto}
 GLOBAL_TOK=$((MICRO_BS * SEQ_LEN * GRAD_ACCUM * DP_SIZE))
 
 echo "============================================"
@@ -84,6 +87,7 @@ exec python train.py \
     --context_parallel_size ${CP_SIZE} \
     --cp_layout ${CP_LAYOUT} \
     --attention_backend ${ATTN_BACKEND} \
+    --moe_dispatch ${MOE_DISPATCH} \
     --micro_batch_size ${MICRO_BS} \
     --gradient_accumulation_steps ${GRAD_ACCUM} \
     --sequence_length ${SEQ_LEN} \
