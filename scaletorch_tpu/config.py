@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import List, Optional
 
 
 @dataclass
@@ -99,6 +99,25 @@ class ModelArguments:
                           "O(N·E·C·H)); index = scatter/gather of the "
                           "O(N·k·H) moving rows (wins at large E). auto "
                           "picks index once num_experts > 16."},
+    )
+    # Interleaved dense/sparse architecture (HF Qwen3MoeConfig knobs):
+    # layer i is sparse iff i not in mlp_only_layers and (i+1) %
+    # decoder_sparse_step == 0. Defaults leave the architecture to the HF
+    # config when --model_name_or_path is set.
+    mlp_only_layers: Optional[List[int]] = field(
+        default=None,
+        metadata={"help": "Layer indices forced to a dense SwiGLU MLP "
+                          "(qwen3_moe; space-separated). Omitted = keep the "
+                          "HF checkpoint's value; pass a single -1 to "
+                          "explicitly CLEAR a checkpoint's list (argparse "
+                          "nargs='+' cannot express an empty list)."},
+    )
+    decoder_sparse_step: Optional[int] = field(
+        default=None,
+        metadata={"help": "A qwen3_moe layer is sparse only when (idx+1) "
+                          "is divisible by this (1 = every layer sparse). "
+                          "Omitted = keep the HF checkpoint's value; an "
+                          "explicit value (including 1) overrides it."},
     )
 
 

@@ -43,12 +43,24 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
         if cfg.model_type == "qwen3_moe":
             # training knobs (capacity, loss coefs) are not in HF configs —
             # thread the CLI values through alongside the architecture fields
+            # interleaved-architecture knobs: EXPLICIT CLI values override
+            # the HF config (including --decoder_sparse_step 1 to force
+            # uniform-sparse, e.g. to re-enable PP); omitted (None) keeps
+            # the checkpoint's architecture. A single -1 clears
+            # mlp_only_layers (nargs='+' cannot express an empty list).
+            arch = {}
+            if cfg.mlp_only_layers is not None:
+                arch["mlp_only_layers"] = tuple(
+                    i for i in cfg.mlp_only_layers if i >= 0)
+            if cfg.decoder_sparse_step is not None:
+                arch["decoder_sparse_step"] = cfg.decoder_sparse_step
             return qwen3_moe.Qwen3MoEConfig.from_hf(
                 hf,
                 capacity_factor=cfg.moe_capacity_factor,
                 moe_dispatch=cfg.moe_dispatch,
                 aux_loss_coef=cfg.router_aux_loss_coef,
                 z_loss_coef=cfg.router_z_loss_coef,
+                **arch,
                 **overrides,
             )
         if cfg.model_type == "qwen3":
@@ -78,6 +90,9 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
             or (cfg.intermediate_size or 4 * cfg.hidden_size),
             capacity_factor=cfg.moe_capacity_factor,
             moe_dispatch=cfg.moe_dispatch,
+            mlp_only_layers=tuple(
+                i for i in (cfg.mlp_only_layers or ()) if i >= 0),
+            decoder_sparse_step=cfg.decoder_sparse_step or 1,
             aux_loss_coef=cfg.router_aux_loss_coef,
             z_loss_coef=cfg.router_z_loss_coef,
             **common,

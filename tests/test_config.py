@@ -56,6 +56,60 @@ class TestParallelArguments:
             ParallelArguments(sequence_parallel=True, tensor_parallel_size=1)
 
 
+class TestInterleavedCliKnobs:
+    def test_cli_flags_reach_model_config(self):
+        from scaletorch_tpu.config import parse_args
+        from scaletorch_tpu.trainer.trainer import build_model_config
+
+        cfg = parse_args([
+            "--model_type", "qwen3_moe", "--num_hidden_layers", "4",
+            "--hidden_size", "32", "--num_attention_heads", "4",
+            "--vocab_size", "64", "--mlp_only_layers", "2",
+            "--decoder_sparse_step", "2",
+        ])
+        mc = build_model_config(cfg)
+        assert mc.sparse_layer_ids() == (1, 3)
+        assert mc.dense_layer_ids() == (0, 2)
+
+    def test_defaults_leave_architecture_uniform(self):
+        from scaletorch_tpu.config import parse_args
+        from scaletorch_tpu.trainer.trainer import build_model_config
+
+        cfg = parse_args([
+            "--model_type", "qwen3_moe", "--num_hidden_layers", "2",
+            "--hidden_size", "32", "--num_attention_heads", "4",
+            "--vocab_size", "64",
+        ])
+        assert build_model_config(cfg).is_uniform_sparse
+
+    def test_explicit_overrides_beat_hf_checkpoint(self, tmp_path):
+        """--decoder_sparse_step 1 / --mlp_only_layers -1 must force an
+        interleaved HF checkpoint back to uniform-sparse (e.g. to
+        re-enable PP); omitted knobs keep the checkpoint's value."""
+        transformers = pytest.importorskip("transformers")
+        from scaletorch_tpu.config import parse_args
+        from scaletorch_tpu.trainer.trainer import build_model_config
+
+        hf = transformers.Qwen3MoeConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=48, num_hidden_layers=4,
+            num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+            num_experts=4, num_experts_per_tok=2,
+            mlp_only_layers=[2], decoder_sparse_step=2,
+        )
+        hf.save_pretrained(str(tmp_path))
+        base = ["--model_type", "qwen3_moe",
+                "--model_name_or_path", str(tmp_path)]
+        # omitted -> checkpoint architecture kept
+        mc = build_model_config(parse_args(base))
+        assert mc.sparse_layer_ids() == (1, 3)
+        # explicit values (including the defaults 1 / empty) override
+        mc = build_model_config(parse_args(
+            base + ["--decoder_sparse_step", "1",
+                    "--mlp_only_layers", "-1"]))
+        assert mc.is_uniform_sparse
+
+
 class TestComposedArguments:
     def test_seq_divisible_by_cp(self):
         with pytest.raises(ValueError, match="not divisible"):
