@@ -18,12 +18,88 @@ import argparse
 import gc as _gc
 import json
 import os
+import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 _OOM = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory")
+
+
+def _detect_gen(explicit: str | None) -> str:
+    """Chip generation for the prefilter's HBM budget — the fit verdict
+    must be judged against the chip the sweep will RUN on (dots_saveable
+    at seq 16384 overflows a 16GB v5e but fits a 32GB v6e)."""
+    if explicit:
+        return explicit
+    try:
+        import jax
+
+        kind = jax.local_devices()[0].device_kind.lower()
+    except Exception:  # noqa: BLE001 — no device: default budget
+        return "v5e"
+    if "v6" in kind:
+        return "v6e"
+    if "v5" in kind and "lite" in kind:
+        return "v5e"
+    if "v5" in kind:
+        return "v5p"
+    if "v4" in kind:
+        return "v4"
+    return "v5e"
+
+
+def _aot_prefilter(args, variants):
+    """Compile-time HBM verdict per variant via tools/aot_memory.py (its
+    own scrubbed-env subprocess — works with or without a chip). One
+    subprocess per (micro_bs, gc) group: aot_memory takes every remat
+    policy in a single invocation, so the JAX-import/lowering startup is
+    paid per group, not per variant. Returns (kept_variants,
+    dropped_labels); inconclusive compiles fail OPEN (kept) so an AOT
+    infra problem never eats a real measurement."""
+    gen = _detect_gen(args.aot_gen)
+    groups: dict = {}
+    for label, shape in variants:
+        key = (shape.get("micro_bs", 1), bool(shape.get("gc")))
+        groups.setdefault(key, []).append((label, shape))
+
+    kept, dropped = [], []
+    for (bs, gc), members in groups.items():
+        cmd = [sys.executable, os.path.join(REPO, "tools", "aot_memory.py"),
+               "--model", args.model, "--seq", str(args.seq),
+               "--bs", str(bs), "--gen", gen]
+        if gc:
+            policies = []
+            for _, shape in members:
+                p = shape.get("remat_policy", "nothing_saveable")
+                if p not in policies:
+                    policies.append(p)
+            cmd += ["--gc", "--policies", *policies]
+        fit_by_policy: dict = {}
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=2400, cwd=REPO)
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                row = json.loads(line)
+                fit_by_policy[row.get("remat_policy")] = row.get(
+                    "fits_hbm", True)
+        except Exception as e:  # noqa: BLE001 — prefilter is best-effort
+            print(f"aot-prefilter inconclusive for bs={bs} gc={gc} "
+                  f"({repr(e)[:80]}); keeping its variants", flush=True)
+        for label, shape in members:
+            pol = shape.get("remat_policy", "nothing_saveable")
+            if fit_by_policy.get(pol, True):
+                kept.append((label, shape))
+            else:
+                dropped.append(label)
+    # preserve the caller's sweep order
+    order = {label: i for i, (label, _) in enumerate(variants)}
+    kept.sort(key=lambda kv: order[kv[0]])
+    return kept, dropped
 
 
 def main() -> None:
@@ -39,6 +115,15 @@ def main() -> None:
     ap.add_argument("--try_no_gc", action="store_true",
                     help="also try gradient_checkpointing off")
     ap.add_argument("--out", default=None, help="write results JSON here")
+    ap.add_argument("--aot-prefilter", action="store_true",
+                    help="AOT-compile each variant first (local libtpu, no "
+                         "chip) and drop the ones XLA says cannot fit HBM — "
+                         "no chip time is burned on known-OOM rows (e.g. "
+                         "dots_saveable at seq 16384, AOT_SEQ16K.json)")
+    ap.add_argument("--aot-gen", default=None,
+                    choices=["v5e", "v6e", "v5p", "v4"],
+                    help="chip generation for the prefilter's HBM budget; "
+                         "default: detect from the attached device")
     ap.add_argument("--flash-blocks", nargs="*", default=None,
                     metavar="BQxBKV",
                     help="also sweep flash tile sizes on the best "
@@ -72,6 +157,13 @@ def main() -> None:
             ))
 
     results = []
+    if args.aot_prefilter:
+        variants, dropped = _aot_prefilter(args, variants)
+        for label in dropped:
+            results.append({"label": label, "error": "AOT_NO_FIT"})
+            print(f"{label:<28} AOT_NO_FIT (skipped — compile-time OOM)",
+                  flush=True)
+
     for label, shape in variants:
         cfg = make_bench_args(args.model, seq=args.seq, **shape)
         try:
