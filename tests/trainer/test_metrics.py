@@ -69,6 +69,29 @@ class TestSystemTelemetry:
         rec = m.log_step(1, loss=2.0, lr=1e-3, grad_norm=0.5)
         assert "host_cpu_percent" not in rec
 
+    def test_accelerator_env_source(self, tmp_path, monkeypatch):
+        """Power/temp ride the record when a platform source exists
+        (TPU_METRICS_DIR sidecar files) and are ABSENT otherwise — never
+        fabricated."""
+        from scaletorch_tpu.utils.monitor import read_accelerator_environment
+
+        monkeypatch.delenv("TPU_METRICS_DIR", raising=False)
+        base = read_accelerator_environment()
+        # this sandbox has no hwmon; nothing may be invented
+        assert "accel_power_w" not in base and "accel_temp_c" not in base
+
+        (tmp_path / "power").write_text("142.5\n")
+        (tmp_path / "temp").write_text("61.0\n")
+        monkeypatch.setenv("TPU_METRICS_DIR", str(tmp_path))
+        env = read_accelerator_environment()
+        assert env["accel_power_w"] == 142.5
+        assert env["accel_temp_c"] == 61.0
+        # and they flow into a sampled record
+        from scaletorch_tpu.utils.monitor import SystemMonitor
+
+        rec = SystemMonitor().sample(1)
+        assert rec["accel_power_w"] == 142.5
+
     def test_ring_buffer_caps_history(self):
         from scaletorch_tpu.utils.monitor import SystemMonitor
 
