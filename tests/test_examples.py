@@ -119,6 +119,30 @@ def test_trainer_points_examples_models_at_their_mains():
         build_model_config(cfg)
 
 
+def test_moe_example_dispatch_and_interleaved(capsys):
+    """MoE demo: learns under the index dispatch AND the interleaved
+    dense/sparse architecture, and the two dispatch modes agree exactly
+    at the same seed/geometry (identical routing math)."""
+    from examples.moe.train_moe import main
+
+    last = {}
+    for dispatch in ("einsum", "index"):
+        last[dispatch] = main([
+            "--ep", "2", "--seq", "128", "--steps", "10",
+            "--dispatch", dispatch,
+        ])
+        out = capsys.readouterr().out
+        assert f"dispatch={dispatch}" in out
+        first = float(out.split("loss ")[1].split(" ->")[0])
+        assert last[dispatch] < first  # it actually learns
+    assert last["index"] == pytest.approx(last["einsum"], rel=2e-4)
+
+    # interleaved: layers 1,3 sparse / 0,2 dense
+    main(["--ep", "2", "--seq", "128", "--steps", "4",
+          "--sparse-step", "2"])
+    assert "sparse_layers=[1, 3]" in capsys.readouterr().out
+
+
 def test_longctx_example_both_strategies(capsys):
     """CP demo: the loss decreases under both distributed-attention
     strategies and the two agree at the same seed/geometry (both compute

@@ -72,9 +72,12 @@ class TestSystemTelemetry:
     def test_accelerator_env_source(self, tmp_path, monkeypatch):
         """Power/temp ride the record when a platform source exists
         (TPU_METRICS_DIR sidecar files) and are ABSENT otherwise — never
-        fabricated."""
+        fabricated. hwmon is stubbed out so only the sidecar path is
+        under test (a dev box's coretemp must not leak in)."""
+        import scaletorch_tpu.utils.monitor as monitor_mod
         from scaletorch_tpu.utils.monitor import read_accelerator_environment
 
+        monkeypatch.setattr(monitor_mod.glob, "glob", lambda pattern: [])
         monkeypatch.delenv("TPU_METRICS_DIR", raising=False)
         base = read_accelerator_environment()
         # this sandbox has no hwmon; nothing may be invented
