@@ -87,13 +87,10 @@ def main(argv=None) -> float:
           f"dispatch={trainer.model_cfg.resolved_moe_dispatch()} "
           f"sparse_layers={[i for i, s in enumerate(layout) if s]}")
     try:
-        it = iter(trainer.loader)
         first = last = None
         drop = None
         for step in range(args.steps):
-            batch = trainer._device_batch(next(it))
-            trainer.params, trainer.opt_state, m = trainer.step_fn(
-                trainer.params, trainer.opt_state, batch)
+            m = trainer.step()  # public per-step API (draws from the loader)
             last = float(m["loss"])
             drop = float(m["moe_dropped_fraction"])
             if first is None:

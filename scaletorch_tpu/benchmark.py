@@ -37,24 +37,18 @@ def benchmark_config(
     trainer = Trainer(cfg)
     progress("trainer_built")
     try:
-        # Drive step_fn directly (not trainer.train) so timing excludes the
-        # metrics/logging machinery and the final loss is always captured.
-        it = iter(trainer.loader)
+        # Drive trainer.step (the public per-step API, not trainer.train)
+        # so timing excludes the metrics/logging machinery and the final
+        # loss is always captured.
         m = {}
         for _ in range(warmup):  # compile + stabilise
-            batch = trainer._device_batch(next(it))
-            trainer.params, trainer.opt_state, m = trainer.step_fn(
-                trainer.params, trainer.opt_state, batch
-            )
+            m = trainer.step()
         jax.block_until_ready(trainer.params)
         progress("compiled")
 
         t0 = time.perf_counter()
         for _ in range(steps):
-            batch = trainer._device_batch(next(it))
-            trainer.params, trainer.opt_state, m = trainer.step_fn(
-                trainer.params, trainer.opt_state, batch
-            )
+            m = trainer.step()
         # Completion barrier: a host readback of the final loss (which
         # data-depends on every step's param update) cannot return before
         # the work is done, unlike block_until_ready on some remote-tunnel

@@ -144,13 +144,27 @@ class ParallelArguments:
         metadata={"help": "Pipeline schedule: 'afab' = one fwd+bwd SPMD "
                           "pipeline (1F1B-equivalent bubble (pp-1)/(accum+pp-1), "
                           "O(accum) boundary-activation memory); "
+                          "'interleaved' = virtual-stage circular pipeline "
+                          "(bubble cut ~pp_virtual_stages x, the SPMD form "
+                          "of Megatron interleaved 1F1B; needs "
+                          "num_hidden_layers %% (pp*vpp) == 0 and costs "
+                          "vpp x the boundary-activation memory); "
                           "'memory_chunked' = chunked accumulation (1F1B's "
                           "O(pp) boundary memory, ~1.25x slower at pp4/accum8 "
                           "— measured by tools/pp_schedule_compare.py). "
                           "'1f1b' is accepted as a reference-compat alias for "
                           "memory_chunked and WARNS: under SPMD lockstep it "
-                          "is not a throughput win. Prefer afab unless "
-                          "activation memory binds."},
+                          "is not a throughput win. Prefer interleaved when "
+                          "layers divide evenly and memory allows, else afab."},
+    )
+    pp_virtual_stages: int = field(
+        default=1,
+        metadata={"help": "Virtual stages per pp rank for "
+                          "pp_engine='interleaved' (Megatron "
+                          "virtual-pipeline chunks). Each rank owns this "
+                          "many non-contiguous layer chunks; the pipeline "
+                          "bubble shrinks by ~this factor. Must be >= 2 "
+                          "with the interleaved engine, 1 otherwise."},
     )
     sequence_parallel: bool = field(
         default=False, metadata={"help": "Megatron-style SP over the tp axis."}
@@ -170,10 +184,23 @@ class ParallelArguments:
         ):
             if getattr(self, name) < 1:
                 raise ValueError(f"{name} must be >= 1, got {getattr(self, name)}")
-        if self.pp_engine not in ("afab", "memory_chunked", "1f1b"):
+        if self.pp_engine not in ("afab", "memory_chunked", "1f1b",
+                                  "interleaved"):
             raise ValueError(
-                "pp_engine must be 'afab', 'memory_chunked' or the "
-                f"reference-compat alias '1f1b', got {self.pp_engine!r}"
+                "pp_engine must be 'afab', 'interleaved', 'memory_chunked' "
+                f"or the reference-compat alias '1f1b', got {self.pp_engine!r}"
+            )
+        if self.pp_engine == "interleaved":
+            if self.pp_virtual_stages < 2:
+                raise ValueError(
+                    "pp_engine='interleaved' needs pp_virtual_stages >= 2 "
+                    f"(got {self.pp_virtual_stages}); with 1 virtual stage "
+                    "per rank the schedule IS afab — use pp_engine='afab'"
+                )
+        elif self.pp_virtual_stages != 1:
+            raise ValueError(
+                f"pp_virtual_stages={self.pp_virtual_stages} requires "
+                f"pp_engine='interleaved' (got {self.pp_engine!r})"
             )
         if self.pp_engine == "1f1b":
             # Honest-semantics guard (VERDICT r3 weak #3): this framework's

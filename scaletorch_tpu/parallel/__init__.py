@@ -8,12 +8,17 @@ from scaletorch_tpu.parallel.mesh import (  # noqa: F401
     reset_mesh_manager,
 )
 from scaletorch_tpu.parallel.pipeline_parallel import (  # noqa: F401
+    deinterleave_stacked_params,
+    interleave_stacked_params,
+    interleaved_tick_schedule,
     make_llama_pipeline_loss,
     pad_stacked_params,
     padded_stage_counts,
+    pipeline_interleaved_loss,
     pipeline_spmd_loss,
     stage_layer_partition,
     unpad_stacked_params,
+    validate_interleaved_divisibility,
     validate_pp_divisibility,
 )
 from scaletorch_tpu.parallel.fsdp import (  # noqa: F401

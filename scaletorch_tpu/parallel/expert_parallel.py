@@ -301,6 +301,13 @@ def dispatch_tokens_indexed(
     if x.ndim == 2:
         x = x[None]
         routing = {k: v[None] for k, v in routing.items()}
+    if axis is not None:
+        # Mirror gather_tokens_indexed: routing normally derives from
+        # ep-varying activations, but a caller feeding REPLICATED routing
+        # (precomputed indices) would otherwise hit a vma mismatch only on
+        # the dispatch side (ADVICE r4) — pvary is a no-op when already
+        # varying.
+        routing = {k: pvary_missing(v, axis) for k, v in routing.items()}
     g, n, h = x.shape
     k = routing["expert_idx"].shape[-1]
     gi = jnp.broadcast_to(jnp.arange(g)[:, None, None], (g, n, k))

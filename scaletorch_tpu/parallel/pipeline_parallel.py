@@ -39,9 +39,32 @@ pp_comms.py:86-286 blocking P2P), re-designed TPU-first:
     than afab at pp=4/accum=8 (predicted 1.27x from tick counts) — hence
     the honest name: it is 1F1B's memory bound, NOT a faster schedule.
 
+  * ``interleaved`` (virtual-stage) schedule: each pp rank owns ``vpp``
+    NON-contiguous layer chunks (rank r holds virtual stages r, pp+r,
+    2pp+r, ...) and activations circulate the pp ring ``vpp`` times via a
+    wrap-around ppermute — the SPMD re-design of the reference's
+    interleaved 1F1B (pipeline_parallel.py:457-671, Megatron virtual
+    pipeline). Each tick costs 1/(pp*vpp) of the layer stack instead of
+    1/pp, so the (pp-1)-tick fill/drain bubble shrinks ~vpp x:
+    T = M*vpp + pp - 1 chunk-ticks (M % pp == 0) vs afab's (M + pp - 1)
+    stage-ticks — bubble fraction (pp-1)/(M*vpp+pp-1), step time
+    T/(vpp*(M+pp-1)) of afab's (``interleaved_tick_schedule`` is the
+    exact accounting; tests assert it against a discrete-event simulator).
+    The price is vpp x the stored tick-boundary carries (same memory
+    growth as Megatron's interleaved warmup queue) and p2p volume — but
+    the per-tick remat working set SHRINKS by vpp, which can dominate:
+    AOT on qwen3-0.6b pp2/dp2/accum4/seq2048 compiles 6.0 GB temp for
+    vpp=2 vs 8.7 GB for afab at identical FLOPs (AOT_PP_INTERLEAVED.json).
+    Chunks run via lax.switch over STATIC layer slices (no per-tick
+    weight copy); collective soundness: the branch index varies only
+    along pp while in-chunk collectives (tp psum, ep all-to-all) group
+    only devices sharing their pp coordinate, so every collective group
+    always takes the same branch together.
+
 ``stage_layer_partition`` keeps the reference's uneven-layer bookkeeping
 (pipeline_parallel.py:83-133) for checkpoint naming and HF-weight loading;
-the SPMD compute path requires num_layers % pp == 0 (stacked-scan layout).
+the SPMD compute path requires num_layers % pp == 0 (stacked-scan layout);
+the interleaved engine requires num_layers % (pp * vpp) == 0.
 """
 
 from __future__ import annotations
@@ -155,6 +178,230 @@ def unpad_stacked_params(layers: Any, num_layers: int, pp: int) -> Any:
         keep.extend(range(s * slots, s * slots + c))
     idx = jnp.asarray(keep)
     return jax.tree.map(lambda w: w[idx], layers)
+
+
+def validate_interleaved_divisibility(num_layers: int, pp: int, vpp: int) -> None:
+    """The interleaved engine slices each rank's layer shard into vpp even
+    chunks (virtual stages) — both divisions must be exact."""
+    if vpp < 2:
+        raise ValueError(
+            f"pp_virtual_stages must be >= 2 for the interleaved engine, got "
+            f"{vpp} (vpp=1 is exactly the afab schedule — use pp_engine='afab')"
+        )
+    if num_layers % (pp * vpp) != 0:
+        raise ValueError(
+            f"num_hidden_layers={num_layers} not divisible by pp*vpp="
+            f"{pp}*{vpp}={pp * vpp}: the interleaved engine needs even "
+            "virtual-stage chunks (pick a layer count divisible by pp*vpp "
+            "or reduce pp_virtual_stages)"
+        )
+
+
+def _interleaved_layer_order(num_layers: int, pp: int, vpp: int) -> List[int]:
+    """Global layer indices in rank-major interleaved storage order: rank
+    r's pp-shard = [chunk 0 | chunk 1 | ...] where chunk c is virtual
+    stage c*pp + r's contiguous layer block."""
+    lc = num_layers // (pp * vpp)
+    order: List[int] = []
+    for r in range(pp):
+        for c in range(vpp):
+            v = c * pp + r
+            order.extend(range(v * lc, (v + 1) * lc))
+    return order
+
+
+def _check_uniform_stack(layers: Any, num_layers: int) -> None:
+    for leaf in jax.tree_util.tree_leaves(layers):
+        if leaf.shape[0] != num_layers:
+            raise ValueError(
+                f"interleaved pipeline needs uniformly stacked layers "
+                f"(every leaf leading dim == num_hidden_layers={num_layers}, "
+                f"got {leaf.shape[0]}). Subset-stacked trees (dense/sparse "
+                "interleaved MoE architectures) are not supported with "
+                "pp_engine='interleaved' — use 'afab'."
+            )
+
+
+def interleave_stacked_params(
+    layers: Any, num_layers: int, pp: int, vpp: int
+) -> Any:
+    """Permute stacked [L, ...] layer leaves into the interleaved storage
+    order, so the plain leading-axis pp-sharding hands rank r its vpp
+    virtual-stage chunks back-to-back. The reference keeps per-chunk
+    ``nn.ModuleList``s per rank (pipeline_parallel.py:457-671 model_chunks);
+    here the same ownership is a host-side gather before sharding.
+    Inverse: ``deinterleave_stacked_params`` (checkpoint/HF export)."""
+    validate_interleaved_divisibility(num_layers, pp, vpp)
+    _check_uniform_stack(layers, num_layers)
+    idx = jnp.asarray(_interleaved_layer_order(num_layers, pp, vpp))
+    return jax.tree.map(lambda w: w[idx], layers)
+
+
+def deinterleave_stacked_params(
+    layers: Any, num_layers: int, pp: int, vpp: int
+) -> Any:
+    """Inverse of ``interleave_stacked_params``: back to true model order."""
+    validate_interleaved_divisibility(num_layers, pp, vpp)
+    _check_uniform_stack(layers, num_layers)
+    import numpy as _np
+
+    inv = _np.argsort(_np.asarray(_interleaved_layer_order(num_layers, pp, vpp)))
+    idx = jnp.asarray(inv)
+    return jax.tree.map(lambda w: w[idx], layers)
+
+
+def interleaved_finish_ticks(m: int, pp: int, vpp: int) -> List[int]:
+    """Tick at which microbatch i's FINAL chunk (virtual stage vpp*pp - 1,
+    on rank pp-1) completes. Microbatches run in cohorts of pp: cohort k
+    enters the ring at tick k*pp*vpp and circulates vpp laps."""
+    return [
+        (pp - 1) + (i // pp) * pp * vpp + (vpp - 1) * pp + (i % pp)
+        for i in range(m)
+    ]
+
+
+def interleaved_tick_schedule(m: int, pp: int, vpp: int) -> Dict[str, float]:
+    """Exact schedule accounting (the VERDICT-r4 'tick-count accounting').
+
+    Each interleaved tick costs 1/(pp*vpp) of the total layer stack, each
+    afab tick 1/pp; ``relative_step_time`` < 1 means interleaved is
+    faster. For M % pp == 0 the tick count is M*vpp + pp - 1 and the
+    bubble fraction is (pp-1)/(M*vpp+pp-1) — afab's divided by ~vpp."""
+    ticks = interleaved_finish_ticks(m, pp, vpp)[-1] + 1
+    ideal = m * vpp  # fully-utilised chunk-ticks
+    afab_ticks = m + pp - 1
+    return {
+        "ticks": ticks,
+        "ideal_ticks": ideal,
+        "bubble_ticks": ticks - ideal,
+        "bubble_fraction": (ticks - ideal) / ticks,
+        "afab_ticks": afab_ticks,
+        "afab_bubble_fraction": (pp - 1) / afab_ticks,
+        "relative_step_time": ticks / (vpp * afab_ticks),
+    }
+
+
+def pipeline_interleaved_loss(
+    params: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    model_cfg,
+    *,
+    pp_size: int,
+    vpp: int,
+    embed_fn: Callable,
+    chunk_fn: Callable,
+    loss_fn: Callable,
+    pp_axis: str = "pp",
+    all_axes: Sequence[str] = ("dp", "cp", "ep", "tp", "pp"),
+    remat_ticks: bool = True,
+    carry_seq_divisor: int = 1,
+    stage_returns_aux: bool = False,
+    stats_template: Optional[Sequence[str]] = None,
+) -> Any:
+    """Mean loss over M microbatches through the circular interleaved
+    pipeline. Same contract as ``pipeline_spmd_loss`` except:
+
+      * params["layers"] leaves must be in INTERLEAVED storage order
+        (``interleave_stacked_params``) — each rank's pp-shard is its vpp
+        virtual-stage chunks back-to-back.
+      * ``chunk_fn(params, x, pos, c) -> x`` runs LOCAL chunk ``c`` (a
+        traced per-rank scalar in [0, vpp)); the makers below implement it
+        as a dynamic slice of the layer shard.
+      * the ppermute ring WRAPS (pp-1 -> 0): a microbatch circulates vpp
+        laps; rank 0 injects a fresh embed only at its chunk-0 ticks, and
+        final outputs are collected from the scan stack at the statically
+        known finish ticks (``interleaved_finish_ticks``).
+    """
+    ids, tgt, pos = batch["input_ids"], batch["target_ids"], batch["position_ids"]
+    m, b, s = ids.shape
+    axes = tuple(all_axes)
+    period = pp_size * vpp
+    stage = pvary_missing(jax.lax.axis_index(pp_axis), axes)
+    is_first = stage == 0
+    is_last = stage == pp_size - 1
+
+    s_local = s // carry_seq_divisor
+    carry_shape = (b, s_local, model_cfg.hidden_size)
+
+    t_done = interleaved_finish_ticks(m, pp_size, vpp)
+    total_ticks = t_done[-1] + 1
+
+    ids_v = pvary_missing(ids, axes)
+    pos_v = pvary_missing(pos, axes)
+    ring_pairs = [(i, (i + 1) % pp_size) for i in range(pp_size)]
+    ticks_iota = pvary_missing(jnp.arange(total_ticks, dtype=jnp.int32), axes)
+    zero = pvary_missing(jnp.float32(0.0), axes)
+
+    def tick(carry, t):
+        x, pos_c, aux_acc, stats_acc = carry
+        if pp_size > 1:
+            # Circular advance: last tick's outputs move one rank down the
+            # ring, INCLUDING the wrap pp-1 -> 0 that starts the next lap
+            # (mid-circulation carries) or returns a finished output
+            # (immediately overwritten by rank 0's next injection).
+            x, pos_c = jax.lax.ppermute((x, pos_c), pp_axis, ring_pairs)
+        # Static schedule, evaluated per (tick, rank): u ticks after this
+        # rank first went live, cohort u//period, local chunk c, microbatch
+        # id mb. Dead slots (u < 0 fill, mb >= m partial tail) compute on
+        # finite garbage and are masked out of every accumulator.
+        u = t - stage
+        u_c = jnp.maximum(u, 0)
+        w = u_c % period
+        c = w // pp_size
+        mb = (u_c // period) * pp_size + (w % pp_size)
+        live = (u >= 0) & (mb < m)
+        mb_c = jnp.clip(mb, 0, m - 1)
+        inject = is_first & live & (c == 0)
+        ids_t = jnp.take(ids_v, mb_c, axis=0)
+        pos_t = jnp.take(pos_v, mb_c, axis=0)
+        emb = pvary_missing(embed_fn(params, ids_t), axes)
+        x = jnp.where(inject, emb, x)
+        pos_c = jnp.where(inject, pos_t, pos_c)
+        if stage_returns_aux:
+            x, aux, stats = chunk_fn(params, x, pos_c, c)
+            aux_acc = aux_acc + jnp.where(live, pvary_missing(aux, axes), 0.0)
+            stats_acc = jax.tree.map(
+                lambda acc, v: acc + jnp.where(
+                    live, pvary_missing(v, axes), 0.0),
+                stats_acc, stats,
+            )
+        else:
+            x = chunk_fn(params, x, pos_c, c)
+        return (pvary_missing(x, axes), pos_c, aux_acc, stats_acc), x
+
+    if remat_ticks:
+        tick = jax.checkpoint(tick)
+
+    stats0 = {k: zero for k in (stats_template or ())}
+    x0 = pvary_missing(jnp.zeros(carry_shape, model_cfg.dtype), axes)
+    pos0 = pvary_missing(jnp.zeros((s,), pos.dtype), axes)
+    (_, _, aux_acc, stats_acc), ys = jax.lax.scan(
+        tick, (x0, pos0, zero, stats0), ticks_iota
+    )
+    # Microbatch i's final-chunk output sits at STATIC tick t_done[i] on
+    # the last rank; the gather is a constant-index select, so the head+CE
+    # epilogue below runs M times (not once per tick) — same head cost as
+    # afab.
+    outs = ys[jnp.asarray(t_done)]  # [M, B, S', H]
+    outs = pvary_missing(outs, axes)
+    outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
+
+    def mb_loss(acc, xm_tm):
+        x_m, t_m = xm_tm
+        return acc + pvary_missing(loss_fn(params, x_m, t_m), axes), None
+
+    tgt_v = pvary_missing(tgt, axes)
+    loss_sum, _ = jax.lax.scan(mb_loss, zero, (outs, tgt_v))
+    ce_part = jnp.where(is_last, loss_sum, jnp.zeros_like(loss_sum))
+    loss = jax.lax.psum(ce_part + aux_acc, pp_axis) / m
+    if not stage_returns_aux:
+        return loss
+    # Each of the m*vpp*pp live chunk executions contributed one chunk-mean
+    # sample per stat.
+    stats = jax.tree.map(
+        lambda v: jax.lax.psum(v, pp_axis) / (m * vpp * pp_size), stats_acc
+    )
+    return loss, stats
 
 
 def _stage_active_layers(
@@ -316,9 +563,15 @@ def make_llama_pipeline_loss(
     tp_axis: Optional[str] = "tp",
     pp_axis: str = "pp",
     head_weight_fn: Optional[Callable] = None,
+    vpp: int = 1,
 ) -> Callable:
     """Bind the Llama/Qwen3 model pieces into a pipeline loss callable
-    ``(params, batch) -> loss`` for use inside the 5D shard_map."""
+    ``(params, batch) -> loss`` for use inside the 5D shard_map.
+
+    ``vpp > 1`` selects the interleaved virtual-stage engine: the layer
+    shard must arrive in interleaved storage order
+    (``interleave_stacked_params``) and each tick runs one of the rank's
+    vpp chunks via a dynamic slice of the shard."""
     from scaletorch_tpu.models import llama
     from scaletorch_tpu.models.layers import get_cos_sin
     from scaletorch_tpu.models.registry import get_attention_backend
@@ -360,6 +613,42 @@ def make_llama_pipeline_loss(
         head = head_weight_fn(params, model_cfg, tp)
         return fused_vocab_parallel_cross_entropy(x_m, head, t_m, axis=tp)
 
+    if vpp > 1:
+        validate_interleaved_divisibility(
+            model_cfg.num_hidden_layers, mm.pp, vpp)
+        lc = model_cfg.num_hidden_layers // (mm.pp * vpp)
+
+        def chunk_fn(params, x, pos_t, c):
+            cos, sin = get_cos_sin(
+                pos_t.shape[0], model_cfg.actual_head_dim,
+                model_cfg.rope_theta, positions=pos_t,
+            )
+
+            def run_chunk(ci):
+                # STATIC slice per switch branch: XLA aliases it into the
+                # shard buffer, where a dynamic_slice would copy the chunk
+                # weights every tick.
+                chunk = jax.tree.map(
+                    lambda w: w[ci * lc:(ci + 1) * lc], params["layers"])
+                return lambda: llama.decoder_stack(
+                    x, chunk, cos, sin, model_cfg, attn_fn,
+                    tp_axis=tp, sequence_parallel=sp,
+                    gradient_checkpointing=gradient_checkpointing,
+                    remat_policy=remat_policy,
+                )
+
+            return jax.lax.switch(c, [run_chunk(ci) for ci in range(vpp)])
+
+        def interleaved_loss(params, batch):
+            return pipeline_interleaved_loss(
+                params, batch, model_cfg,
+                pp_size=mm.pp, vpp=vpp, embed_fn=embed_fn,
+                chunk_fn=chunk_fn, loss_fn=loss_fn, pp_axis=pp_axis,
+                carry_seq_divisor=mm.tp if sp else 1,
+            )
+
+        return interleaved_loss
+
     def pipeline_loss(params, batch):
         return pipeline_spmd_loss(
             params, batch, model_cfg,
@@ -383,6 +672,7 @@ def make_moe_pipeline_loss(
     ep_axis: Optional[str] = "ep",
     pp_axis: str = "pp",
     head_weight_fn: Optional[Callable] = None,
+    vpp: int = 1,
 ) -> Callable:
     """Bind the Qwen3-MoE pieces into a pipeline loss
     ``(params, batch) -> (loss, moe_stats)`` — PP x EP composition.
@@ -432,6 +722,42 @@ def make_moe_pipeline_loss(
                                  sequence_parallel=sp)
         head = head_weight_fn(params, model_cfg, tp)
         return fused_vocab_parallel_cross_entropy(x_m, head, t_m, axis=tp)
+
+    if vpp > 1:
+        validate_interleaved_divisibility(
+            model_cfg.num_hidden_layers, mm.pp, vpp)
+        lc = model_cfg.num_hidden_layers // (mm.pp * vpp)
+
+        def chunk_fn(params, x, pos_t, c):
+            cos, sin = get_cos_sin(
+                pos_t.shape[0], model_cfg.actual_head_dim,
+                model_cfg.rope_theta, positions=pos_t,
+            )
+
+            def run_chunk(ci):
+                # static slice per branch (no per-tick weight copy)
+                chunk = jax.tree.map(
+                    lambda w: w[ci * lc:(ci + 1) * lc], params["layers"])
+                return lambda: qwen3_moe.moe_decoder_stack(
+                    x, chunk, cos, sin, model_cfg, attn_fn, helpers,
+                    tp_axis=tp, ep_axis=ep, sequence_parallel=sp,
+                    gradient_checkpointing=gradient_checkpointing,
+                    remat_policy=remat_policy,
+                )
+
+            return jax.lax.switch(c, [run_chunk(ci) for ci in range(vpp)])
+
+        def interleaved_loss(params, batch):
+            return pipeline_interleaved_loss(
+                params, batch, model_cfg,
+                pp_size=mm.pp, vpp=vpp, embed_fn=embed_fn,
+                chunk_fn=chunk_fn, loss_fn=loss_fn, pp_axis=pp_axis,
+                carry_seq_divisor=mm.tp if sp else 1,
+                stage_returns_aux=True,
+                stats_template=MOE_PIPELINE_STATS,
+            )
+
+        return interleaved_loss
 
     def pipeline_loss(params, batch):
         return pipeline_spmd_loss(

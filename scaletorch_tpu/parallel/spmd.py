@@ -128,6 +128,7 @@ def _build_losses(
     cp_layout: str = "contiguous",
     custom_pipeline_loss: Optional[Callable] = None,
     custom_pipeline_has_aux: bool = False,
+    pp_vpp: int = 1,
 ) -> Tuple[Callable, Optional[Callable], bool]:
     """(loss_fn, pipe_loss, pipe_has_aux) — the per-microbatch loss for the
     non-PP path and, when mm.pp > 1, the pipeline loss. Shared by the
@@ -174,16 +175,29 @@ def _build_losses(
     if mm.pp == 1:
         return loss_fn, None, False
 
-    if pp_schedule not in ("afab", "memory_chunked", "1f1b"):
+    if pp_schedule not in ("afab", "memory_chunked", "1f1b", "interleaved"):
         raise ValueError(
-            "pp_schedule must be 'afab' or 'memory_chunked' (alias '1f1b'), "
-            f"got {pp_schedule}"
+            "pp_schedule must be 'afab', 'interleaved' or 'memory_chunked' "
+            f"(alias '1f1b'), got {pp_schedule}"
         )
+    vpp = pp_vpp if pp_schedule == "interleaved" else 1
     if custom_pipeline_loss is not None:
         # Custom model families run PP through the public protocol: build
         # a ``(params, batch) -> loss`` with pipeline_spmd_loss over your
         # own embed_fn/stage_fn/loss_fn (see pipeline_parallel.py
         # docstring) and hand it in here.
+        if pp_schedule == "interleaved":
+            # The engine cannot be applied to an opaque loss — the caller
+            # builds the interleaved variant themselves; silently running
+            # their afab-contract loss against interleaved-order params
+            # would train a scrambled model.
+            raise ValueError(
+                "pp_schedule='interleaved' does not apply to a "
+                "custom_pipeline_loss: build the custom loss on "
+                "pipeline_parallel.pipeline_interleaved_loss (embed_fn/"
+                "chunk_fn/loss_fn) and pass pp_schedule='afab' — the "
+                "schedule lives inside the custom loss"
+            )
         return loss_fn, custom_pipeline_loss, custom_pipeline_has_aux
     if model_family == "qwen3_moe":
         # PP x EP: each stage's MoE layers run the ep all-to-all inside
@@ -200,6 +214,7 @@ def _build_losses(
             remat_policy=remat_policy,
             sequence_parallel=sequence_parallel,
             head_weight_fn=head_weight_fn,
+            vpp=vpp,
         )
         return loss_fn, pipe_loss, True
     if custom_param_specs:
@@ -225,6 +240,7 @@ def _build_losses(
         remat_policy=remat_policy,
         sequence_parallel=sequence_parallel,
         head_weight_fn=head_weight_fn,
+        vpp=vpp,
     )
     return loss_fn, pipe_loss, False
 
@@ -241,11 +257,17 @@ def make_spmd_eval_step(
     model_kwargs: Optional[Dict[str, Any]] = None,
     model_family: str = "llama",
     cp_layout: str = "contiguous",
+    pp_schedule: str = "afab",
+    pp_vpp: int = 1,
 ) -> Tuple[Callable, Any]:
     """Jitted validation step ``(params, batch) -> loss`` over the same 5D
     mesh and loss form as the train step, minus backward/update — the
     Trainer's validation loop (role of reference make_eval_step +
-    trainer eval leg). Returns (eval_fn, param_specs)."""
+    trainer eval leg). Returns (eval_fn, param_specs).
+
+    ``pp_schedule``/``pp_vpp`` must match the TRAIN step when the engine is
+    'interleaved': the layer shard arrives in interleaved storage order, so
+    an afab eval pipeline would stack the wrong layers per stage."""
     use_pp = mm.pp > 1
     p_specs = (
         param_specs
@@ -267,8 +289,11 @@ def make_spmd_eval_step(
         custom_param_specs=param_specs is not None,
         model_kwargs=model_kwargs,
         model_family=model_family,
-        pp_schedule="afab",
+        # memory_chunked is a train-side accumulation strategy; eval always
+        # runs one pipeline pass, so only 'interleaved' changes the graph.
+        pp_schedule="interleaved" if pp_schedule == "interleaved" else "afab",
         cp_layout=cp_layout,
+        pp_vpp=pp_vpp,
     )
     all_axes = DATA_AXES + ("ep",) + (("tp", "pp") if use_pp else ("tp",))
 
@@ -323,6 +348,7 @@ def make_spmd_train_step(
     cp_layout: str = "contiguous",
     custom_pipeline_loss: Optional[Callable] = None,
     custom_pipeline_has_aux: bool = False,
+    pp_vpp: int = 1,
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -358,15 +384,37 @@ def make_spmd_train_step(
         )
 
         lead = jax.tree_util.tree_leaves(params["layers"])[0].shape[0]
-        _, slots = padded_stage_counts(model_cfg.num_hidden_layers, mm.pp)
-        if lead != slots * mm.pp:
-            raise ValueError(
-                f"stacked layer axis has {lead} slots but pp={mm.pp} with "
-                f"num_hidden_layers={model_cfg.num_hidden_layers} needs "
-                f"{slots * mm.pp}; pad uneven layer counts first with "
-                f"pipeline_parallel.pad_stacked_params(params['layers'], "
-                f"{model_cfg.num_hidden_layers}, {mm.pp})"
+        if pp_schedule == "interleaved":
+            # No padding support: the engine needs L % (pp*vpp) == 0 and a
+            # uniformly stacked tree, checked here AND by the param
+            # interleave (interleave_stacked_params in the Trainer).
+            from scaletorch_tpu.parallel.pipeline_parallel import (
+                validate_interleaved_divisibility,
             )
+
+            validate_interleaved_divisibility(
+                model_cfg.num_hidden_layers, mm.pp, pp_vpp)
+            if lead != model_cfg.num_hidden_layers:
+                # chunk_fn's basic slicing would CLIP a mis-sized axis
+                # silently (wrong layers, no error) — catch it here like
+                # the afab branch catches its padding mismatch.
+                raise ValueError(
+                    f"interleaved pipeline needs the stacked layer axis == "
+                    f"num_hidden_layers={model_cfg.num_hidden_layers}, got "
+                    f"{lead}; unpad/deinterleave first, then "
+                    f"interleave_stacked_params(layers, "
+                    f"{model_cfg.num_hidden_layers}, {mm.pp}, {pp_vpp})"
+                )
+        else:
+            _, slots = padded_stage_counts(model_cfg.num_hidden_layers, mm.pp)
+            if lead != slots * mm.pp:
+                raise ValueError(
+                    f"stacked layer axis has {lead} slots but pp={mm.pp} with "
+                    f"num_hidden_layers={model_cfg.num_hidden_layers} needs "
+                    f"{slots * mm.pp}; pad uneven layer counts first with "
+                    f"pipeline_parallel.pad_stacked_params(params['layers'], "
+                    f"{model_cfg.num_hidden_layers}, {mm.pp})"
+                )
     p_specs = (
         param_specs
         if param_specs is not None
@@ -394,6 +442,7 @@ def make_spmd_train_step(
         cp_layout=cp_layout,
         custom_pipeline_loss=custom_pipeline_loss,
         custom_pipeline_has_aux=custom_pipeline_has_aux,
+        pp_vpp=pp_vpp,
     )
 
     # 'ep' is always a data axis for the batch (batch_specs shards rows
@@ -450,9 +499,12 @@ def make_spmd_train_step(
             ex = {k: pvary_missing(v, all_axes) for k, v in ex.items()}
             return l, ex, g
 
-        if use_pp and pp_schedule == "afab":
+        if use_pp and pp_schedule in ("afab", "interleaved"):
             # One pipeline over all microbatches; autodiff yields the
-            # mirrored backward pipeline (all-forward-all-backward).
+            # mirrored backward pipeline (all-forward-all-backward; the
+            # interleaved engine differentiates its circular tick loop the
+            # same way, with the bubble cut ~vpp x —
+            # pipeline_parallel.interleaved_tick_schedule).
             # NOTE on schedule accounting (VERDICT r1 weak #3): in SPMD
             # every stage ticks in lockstep, so this fwd+bwd pipeline costs
             # (M + pp - 1) forward ticks + (M + pp - 1) backward ticks —

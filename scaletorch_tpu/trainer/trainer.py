@@ -318,6 +318,25 @@ class Trainer:
                 cfg.pipeline_parallel_size,
             )
 
+        if (cfg.pipeline_parallel_size > 1
+                and cfg.pp_engine == "interleaved"):
+            # Virtual-stage engine: permute the stacked layer axis into
+            # rank-major interleaved order so the plain pp-sharding hands
+            # each rank its vpp chunks. HF/export callers must invert with
+            # deinterleave_stacked_params (same contract as uneven-PP
+            # padding above).
+            from scaletorch_tpu.parallel.pipeline_parallel import (
+                interleave_stacked_params,
+            )
+
+            params_host = dict(params_host)
+            params_host["layers"] = interleave_stacked_params(
+                params_host["layers"],
+                self.model_cfg.num_hidden_layers,
+                cfg.pipeline_parallel_size,
+                cfg.pp_virtual_stages,
+            )
+
         # clip-free optimizer: the SPMD step applies TP-correct clipping.
         # Adafactor additionally needs the param layout + mesh sizes so its
         # factored statistics reduce across sharded dims (trainer/factored.py).
@@ -354,6 +373,7 @@ class Trainer:
             max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
             pp_schedule=cfg.pp_engine,
+            pp_vpp=cfg.pp_virtual_stages,
             cp_layout=cfg.cp_layout,
             param_specs=param_specs,
             model_kwargs=model_kwargs,
@@ -392,6 +412,7 @@ class Trainer:
         )
         self.global_step = 0
         self.tokens_seen = 0
+        self._train_iter = None
         self._ckpt_mgr = None
         self._eval_fn = None
         self._eval_loader = None
@@ -409,6 +430,8 @@ class Trainer:
                 model_kwargs=model_kwargs,
                 model_family="qwen3_moe" if is_moe else "llama",
                 cp_layout=cfg.cp_layout,
+                pp_schedule=cfg.pp_engine,
+                pp_vpp=cfg.pp_virtual_stages,
             )
             self._eval_loader = self._build_eval_loader()
 
@@ -510,17 +533,38 @@ class Trainer:
             for k, v in batch.items()
         }
 
+    def step(self, batch: Optional[Dict[str, np.ndarray]] = None) -> Dict[str, Any]:
+        """Run ONE optimizer step and return its raw metrics dict.
+
+        The public per-step entry point for custom loops (examples,
+        benchmark harnesses — the reference exposes the same granularity
+        as train_step(model, batch, ...), train_step.py:47-136): draws the
+        next loader batch when ``batch`` is None (one persistent iterator,
+        so successive calls continue the stream), moves it to the mesh,
+        applies the jitted SPMD step and advances the step/token counters.
+        Metrics logging, eval and checkpoint cadence stay in ``train`` —
+        this method is just the step.
+        """
+        if batch is None:
+            if self._train_iter is None:
+                self._train_iter = iter(self.loader)
+            batch = next(self._train_iter)
+        dev_batch = self._device_batch(batch)
+        self.params, self.opt_state, m = self.step_fn(
+            self.params, self.opt_state, dev_batch
+        )
+        self.global_step += 1
+        # count the batch actually trained on (a caller-supplied batch may
+        # differ from the loader's nominal shape), and the HOST-GLOBAL
+        # batch at that — every process sees the same global arrays.
+        self.tokens_seen += int(np.prod(np.shape(batch["input_ids"])))
+        return m
+
     def train(self, num_steps: Optional[int] = None) -> Dict[str, Any]:
         num_steps = num_steps or self.cfg.total_train_steps
-        it = iter(self.loader)
         last = {}
         for _ in range(num_steps):
-            batch = self._device_batch(next(it))
-            self.params, self.opt_state, m = self.step_fn(
-                self.params, self.opt_state, batch
-            )
-            self.global_step += 1
-            self.tokens_seen += self.loader.tokens_per_step
+            m = self.step()
             last = self.metrics.log_step(
                 self.global_step,
                 loss=m["loss"],
@@ -595,7 +639,10 @@ class Trainer:
         self.global_step = restored["step"]
         self.tokens_seen = restored["extra"].get("tokens_seen", 0)
         # Fast-forward the data stream so resumed training continues the
-        # dataset walk instead of replaying it (sampler epoch parity).
+        # dataset walk instead of replaying it (sampler epoch parity). A
+        # live step() iterator predates set_state and would keep yielding
+        # from the old position — drop it so the next step() re-iterates.
         if hasattr(self.loader, "set_state"):
             self.loader.set_state(self.global_step)
+        self._train_iter = None
         self.logger.info(f"resumed from step {self.global_step}")

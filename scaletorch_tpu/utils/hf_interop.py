@@ -386,6 +386,7 @@ def save_hf_params(
     *,
     dtype: str = "float32",
     max_shard_bytes: int = 5 * 1024**3,
+    pp_interleaved: "tuple[int, int] | None" = None,
 ) -> str:
     """Write our param tree as a HF-layout safetensors checkpoint.
 
@@ -395,10 +396,24 @@ def save_hf_params(
     model-0000x-of-0000N.safetensors + model.safetensors.index.json —
     exactly what transformers/safe_open expect, one shard materialised at
     a time. Returns the single file path, or the index path when sharded.
+
+    ``pp_interleaved=(pp, vpp)``: the tree was trained with
+    pp_engine='interleaved', whose layer axis is PERMUTED into rank-major
+    virtual-stage order — a shape check cannot catch it, so the caller
+    MUST declare it and the layers are deinterleaved here before export
+    (pipeline_parallel.interleave_stacked_params is the inverse).
     """
 
     if dtype not in ("float32", "bfloat16"):
         raise ValueError(f"dtype must be float32|bfloat16, got {dtype!r}")
+    if pp_interleaved is not None:
+        from scaletorch_tpu.parallel.pipeline_parallel import (
+            deinterleave_stacked_params,
+        )
+
+        pp, vpp = pp_interleaved
+        params = dict(params, layers=deinterleave_stacked_params(
+            params["layers"], cfg.num_hidden_layers, pp, vpp))
     # anchor the padding check on an all-layers key: interleaved MoE trees
     # legitimately stack MLP/expert keys over layer SUBSETS
     n_stacked = params["layers"]["input_layernorm"].shape[0]

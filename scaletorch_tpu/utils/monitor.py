@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import glob
 import os
+import re
 import time
 from collections import deque
 from typing import Any, Deque, Dict, Optional
@@ -37,6 +38,9 @@ def _read_first_number(path: str) -> Optional[float]:
         return None
 
 
+_ACCEL_HWMON_NAMES = re.compile(r"tpu|accel|apex|npu", re.IGNORECASE)
+
+
 def read_accelerator_environment() -> Dict[str, float]:
     """Power (W) / temperature (C) from whatever the platform exposes.
 
@@ -44,20 +48,26 @@ def read_accelerator_environment() -> Dict[str, float]:
     TPU VM images), then any ``TPU_METRICS_DIR`` text files named
     ``power``/``temp``. Returns {} when nothing is exposed — callers and
     JSON consumers must treat these fields as optional.
+
+    hwmon channels are attributed to the accelerator (``accel_*``) only
+    when the chip's ``name`` file matches an accelerator driver; anything
+    else (coretemp, an NVMe sensor) is reported as ``hwmon_*`` so a host
+    CPU temperature can never masquerade as chip telemetry.
     """
     out: Dict[str, float] = {}
-    for temp_path in sorted(glob.glob("/sys/class/hwmon/hwmon*/temp1_input")):
-        v = _read_first_number(temp_path)
+    for hw_dir in sorted(glob.glob("/sys/class/hwmon/hwmon*")):
+        try:
+            with open(os.path.join(hw_dir, "name")) as f:
+                chip = f.read().strip()
+        except OSError:
+            chip = ""
+        prefix = "accel" if _ACCEL_HWMON_NAMES.search(chip) else "hwmon"
+        v = _read_first_number(os.path.join(hw_dir, "temp1_input"))
         if v is not None:
-            out["accel_temp_c"] = v / 1000.0  # hwmon reports millidegrees
-            break
-    for power_path in sorted(
-        glob.glob("/sys/class/hwmon/hwmon*/power1_average")
-    ):
-        v = _read_first_number(power_path)
+            out.setdefault(f"{prefix}_temp_c", v / 1000.0)  # millidegrees
+        v = _read_first_number(os.path.join(hw_dir, "power1_average"))
         if v is not None:
-            out["accel_power_w"] = v / 1e6  # hwmon reports microwatts
-            break
+            out.setdefault(f"{prefix}_power_w", v / 1e6)  # microwatts
     metrics_dir = os.environ.get("TPU_METRICS_DIR", "")
     if metrics_dir:
         for name, key, scale in (

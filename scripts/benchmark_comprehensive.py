@@ -55,6 +55,8 @@ CORRECTNESS_CONFIGS = [
     ("tiny-PP2-DP4",         "dense-tiny", 1, 2, 4, 1, 1, 2, 2, 256, False, False, "memory_chunked"),
     ("tiny-PP4-DP2-afab",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "afab"),
     ("tiny-PP4-DP2-1f1b",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "memory_chunked"),
+    ("tiny-PP2-VPP2-DP4",    "dense-tiny", 1, 2, 4, 1, 1, 2, 4, 256, False, False, "interleaved",
+     {"pp_virtual_stages": 2}),  # virtual-stage circular pipeline (L=4 = pp*vpp)
     # --- CP (ring runs the zigzag layout by default; ulysses = the
     # all-to-all head-scatter strategy) ---
     ("tiny-CP2-DP4",         "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "memory_chunked"),

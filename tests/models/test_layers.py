@@ -29,6 +29,7 @@ class TestRmsNorm:
         out = rms_norm(x, jnp.ones(8))
         assert out.dtype == jnp.bfloat16
 
+    @pytest.mark.slow
     def test_memory_lean_vjp_matches_autodiff(self):
         """The custom VJP (saves original-dtype x/w, recomputes fp32
         internals) must agree with plain autodiff of the same math."""

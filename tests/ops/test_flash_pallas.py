@@ -30,6 +30,7 @@ def test_forward_matches_sdpa(causal):
     assert jnp.max(jnp.abs(out - ref)) < 1e-5
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [True, False])
 def test_gradients_match_sdpa(causal):
     q, k, v = _qkv(s=128, d=32)
@@ -60,6 +61,7 @@ def test_mqa_single_kv_head():
     assert jnp.max(jnp.abs(out - ref)) < 1e-5
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("bq,bkv", [(64, 32), (32, 64)])
 def test_mismatched_block_sizes_causal(bq, bkv):
     # regression: the causal DMA clamp must convert between query- and

@@ -43,6 +43,7 @@ class TestKernelParity:
         # rows past the fill count are structurally zero
         assert float(jnp.abs(out[0, 0, int(counts[0, 0]):]).max()) == 0.0
 
+    @pytest.mark.slow
     def test_vjp_matches_masked_dense(self):
         x, counts, wg, wu, wd = _problem()
 

@@ -389,6 +389,7 @@ class TestIndexedDispatch:
             slot_fill_counts(dispatch),
         )
 
+    @pytest.mark.slow
     def test_model_forward_matches_einsum_mode(self):
         import dataclasses
 
@@ -402,6 +403,7 @@ class TestIndexedDispatch:
             atol=2e-5,
         )
 
+    @pytest.mark.slow
     def test_grads_match_einsum_mode(self):
         import dataclasses
 

@@ -4,6 +4,7 @@ itself golden-tested against dense softmax CE in test_tensor_parallel)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 from scaletorch_tpu.parallel.tensor_parallel import (
@@ -61,6 +62,7 @@ def test_fused_gradients_match(mm_factory):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_fused_no_tp_axis():
     """axis=None path (single-device semantics, no collectives)."""
     key = jax.random.key(1)

@@ -28,6 +28,7 @@ def _params():
 
 
 class TestUnshardedParity:
+    @pytest.mark.slow
     def test_matches_optax_adafactor_over_steps(self):
         params = _params()
         specs = jax.tree.map(lambda _: P(), params)

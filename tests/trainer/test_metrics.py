@@ -95,6 +95,37 @@ class TestSystemTelemetry:
         rec = SystemMonitor().sample(1)
         assert rec["accel_power_w"] == 142.5
 
+    def test_hwmon_attribution_by_chip_name(self, tmp_path, monkeypatch):
+        """A coretemp/NVMe hwmon sensor must surface as hwmon_*, never as
+        accel_* — only chips whose driver name matches an accelerator
+        (tpu/accel/apex/npu) get chip attribution (ADVICE r4)."""
+        import scaletorch_tpu.utils.monitor as monitor_mod
+        from scaletorch_tpu.utils.monitor import read_accelerator_environment
+
+        host = tmp_path / "hwmon0"
+        host.mkdir()
+        (host / "name").write_text("coretemp\n")
+        (host / "temp1_input").write_text("45000\n")
+        accel = tmp_path / "hwmon1"
+        accel.mkdir()
+        (accel / "name").write_text("apex\n")
+        (accel / "temp1_input").write_text("61000\n")
+        (accel / "power1_average").write_text("142500000\n")
+        monkeypatch.setattr(
+            monitor_mod.glob, "glob", lambda pattern: [str(host), str(accel)]
+        )
+        monkeypatch.delenv("TPU_METRICS_DIR", raising=False)
+        env = read_accelerator_environment()
+        assert env["hwmon_temp_c"] == 45.0       # host CPU, not the chip
+        assert env["accel_temp_c"] == 61.0
+        assert env["accel_power_w"] == 142.5
+        # host-only box: accel_* entirely absent
+        monkeypatch.setattr(
+            monitor_mod.glob, "glob", lambda pattern: [str(host)]
+        )
+        env = read_accelerator_environment()
+        assert "accel_temp_c" not in env and "accel_power_w" not in env
+
     def test_ring_buffer_caps_history(self):
         from scaletorch_tpu.utils.monitor import SystemMonitor
 

@@ -63,6 +63,7 @@ class TestGradAccumulation:
 
 
 class TestTrainStep:
+    @pytest.mark.slow
     def test_memorizes_fixed_batch(self, params):
         args = ScaleTorchTPUArguments(total_train_steps=40, learning_rate=3e-3)
         tx, _ = create_optimizer(args)
@@ -102,7 +103,13 @@ class TestTrainStep:
 
 
 class TestOptimizers:
-    @pytest.mark.parametrize("name", ["adamw", "adam", "sgd", "lamb", "adafactor"])
+    @pytest.mark.parametrize("name", [
+        "adamw",
+        pytest.param("adam", marks=pytest.mark.slow),
+        pytest.param("sgd", marks=pytest.mark.slow),
+        pytest.param("lamb", marks=pytest.mark.slow),
+        pytest.param("adafactor", marks=pytest.mark.slow),
+    ])
     def test_all_optimizers_step(self, params, name):
         args = ScaleTorchTPUArguments(
             total_train_steps=10, optimizer_name=name, learning_rate=1e-3

@@ -60,6 +60,7 @@ def _hf_logits(model, ids):
 
 
 class TestLoadHF:
+    @pytest.mark.slow
     def test_llama_logits_match(self, tmp_path):
         model, hf_cfg, path = _tiny_hf_llama(tmp_path)
         cfg = LlamaConfig.from_hf(hf_cfg, dtype=jnp.float32)
@@ -69,6 +70,7 @@ class TestLoadHF:
         theirs = _hf_logits(model, ids)
         np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_qwen3_logits_match(self, tmp_path):
         model, hf_cfg, path = _tiny_hf_qwen3(tmp_path)
         cfg = Qwen3Config.from_hf(hf_cfg, dtype=jnp.float32)
@@ -326,6 +328,7 @@ class TestInterleavedDenseMoE:
         assert cfg.moe_segments() == (
             (False, 0, 1), (True, 1, 2), (False, 2, 3), (True, 3, 4))
 
+    @pytest.mark.slow
     def test_logits_match_hf(self, tmp_path):
         from scaletorch_tpu.models.qwen3_moe import Qwen3MoEConfig, forward
 
@@ -394,3 +397,33 @@ def test_save_rejects_padded_uneven_pp_tree(tmp_path):
     # and the documented fix round-trips
     fixed = dict(padded, layers=unpad_stacked_params(padded["layers"], 3, 2))
     save_hf_params(str(tmp_path / "ok"), fixed, cfg)
+
+
+@pytest.mark.slow
+def test_save_deinterleaves_interleaved_pp_tree(tmp_path):
+    """pp_engine='interleaved' permutes the layer axis with UNCHANGED
+    shape — invisible to any check, so the caller declares it via
+    pp_interleaved and the export must equal the true-order export
+    byte-for-byte."""
+    import jax
+    from safetensors import safe_open
+
+    from scaletorch_tpu.models.llama import LlamaConfig, init_params
+    from scaletorch_tpu.parallel.pipeline_parallel import (
+        interleave_stacked_params,
+    )
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=16, intermediate_size=32,
+        num_hidden_layers=4, num_attention_heads=2, num_key_value_heads=2,
+    )
+    params = init_params(jax.random.PRNGKey(0), cfg)
+    save_hf_params(str(tmp_path / "true"), params, cfg)
+    inter = dict(params, layers=interleave_stacked_params(
+        params["layers"], 4, 2, 2))
+    save_hf_params(str(tmp_path / "decl"), inter, cfg, pp_interleaved=(2, 2))
+    with safe_open(str(tmp_path / "true" / "model.safetensors"), "np") as a, \
+            safe_open(str(tmp_path / "decl" / "model.safetensors"), "np") as b:
+        assert set(a.keys()) == set(b.keys())
+        for k in a.keys():
+            np.testing.assert_array_equal(a.get_tensor(k), b.get_tensor(k))

@@ -58,7 +58,7 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
                   param_dtype: str = "float32", optimizer: str = "adamw",
                   dp: int = 1, tp: int = 1, cp: int = 1, pp: int = 1,
                   ep: int = 1, sp: bool = False, pp_engine: str = "afab",
-                  moe_dispatch: str = "auto"):
+                  pp_vpp: int = 1, moe_dispatch: str = "auto"):
     """Lower the real SPMD train step against an AOT TPU topology —
     single chip by default, or a multi-chip mesh factoring (dp/tp/cp/pp/
     ep over the 4-chip v5e host topology): Mosaic kernel compilation for
@@ -95,7 +95,8 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
                           pp_engine=pp_engine,
                           extra={"param_dtype": param_dtype,
                                  "optimizer_name": optimizer,
-                                 "moe_dispatch": moe_dispatch})
+                                 "moe_dispatch": moe_dispatch,
+                                 "pp_virtual_stages": pp_vpp})
     model_cfg = build_model_config(cfg)
     mm = MeshManager(devices=list(topo.devices[:world]),
                      dp=dp, pp=pp, cp=cp, ep=ep, tp=tp)
@@ -142,6 +143,7 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
         model_kwargs={"ep_axis": "ep" if ep > 1 else None} if is_moe else None,
         model_family="qwen3_moe" if is_moe else "llama",
         pp_schedule=cfg.pp_engine,
+        pp_vpp=pp_vpp,
         cp_layout=cfg.cp_layout,
     )
     opt_state = jax.eval_shape(tx.init, params)
@@ -164,7 +166,7 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
         optimizer=args_ns.optimizer,
         dp=args_ns.dp, tp=args_ns.tp, cp=args_ns.cp, pp=args_ns.pp,
         ep=args_ns.ep, sp=args_ns.sp, pp_engine=args_ns.pp_engine,
-        moe_dispatch=args_ns.moe_dispatch)
+        pp_vpp=args_ns.pp_vpp, moe_dispatch=args_ns.moe_dispatch)
     # XLA:TPU enforces the HBM budget at compile time (RESOURCE_EXHAUSTED
     # on overflow), so a successful compile IS the fit verdict — the
     # caller's except path records the failure. The size fields below are
@@ -214,10 +216,15 @@ def main() -> None:
     for ax in ("dp", "tp", "cp", "pp", "ep"):
         ap.add_argument(f"--{ax}", type=int, default=1)
     ap.add_argument("--sp", action="store_true", help="sequence parallel")
-    ap.add_argument("--pp-engine", default="afab", choices=["afab", "memory_chunked", "1f1b"],
+    ap.add_argument("--pp-engine", default="afab",
+                    choices=["afab", "memory_chunked", "1f1b", "interleaved"],
                     help="pipeline schedule to analyze (afab is the "
                          "config/train.py default; memory_chunked (alias 1f1b) is the O(pp)-memory "
-                         "chunked schedule)")
+                         "chunked schedule; interleaved is the virtual-stage "
+                         "circular pipeline — pair with --pp-vpp)")
+    ap.add_argument("--pp-vpp", type=int, default=1,
+                    help="virtual stages per rank (pp_engine=interleaved); "
+                         "the vpp x tick-carry memory shows up in temp_gb")
     ap.add_argument("--moe-dispatch", default="auto",
                     choices=["auto", "einsum", "index"],
                     help="capacity-dispatch token movement (MoE models)")

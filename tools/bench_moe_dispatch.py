@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -46,10 +47,17 @@ def main() -> None:
     if args.cpu:
         os.environ["JAX_PLATFORMS"] = "cpu"
         os.environ["PALLAS_AXON_POOL_IPS"] = ""
-        os.environ["XLA_FLAGS"] = (
-            f"--xla_force_host_platform_device_count="
-            f"{max(args.ep * args.dp, 1)}"
+        # APPEND to any operator-exported XLA_FLAGS (replacing only a
+        # stale device-count flag) instead of clobbering them
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
         )
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count="
+            f"{max(args.ep * args.dp, 1)}"
+        ).strip()
     import jax
 
     if args.cpu:

@@ -59,16 +59,33 @@ def _aot_prefilter(args, variants):
     dropped_labels); inconclusive compiles fail OPEN (kept) so an AOT
     infra problem never eats a real measurement."""
     gen = _detect_gen(args.aot_gen)
+
+    def _run_knobs(shape):
+        """The non-policy knobs that change the compiled memory picture.
+        Pulled from the variant shape (extra{} carries config-level keys)
+        so the prefilter compiles EXACTLY what the sweep will run — a
+        future sweep knob (accum, optimizer, master-param dtype) must not
+        silently diverge the fit verdict (ADVICE r4)."""
+        extra = shape.get("extra") or {}
+        return (
+            shape.get("micro_bs", 1),
+            bool(shape.get("gc")),
+            shape.get("grad_accum", 1),
+            extra.get("optimizer_name", "adamw"),
+            extra.get("param_dtype", "float32"),
+        )
+
     groups: dict = {}
     for label, shape in variants:
-        key = (shape.get("micro_bs", 1), bool(shape.get("gc")))
-        groups.setdefault(key, []).append((label, shape))
+        groups.setdefault(_run_knobs(shape), []).append((label, shape))
 
     kept, dropped = [], []
-    for (bs, gc), members in groups.items():
+    for (bs, gc, accum, optimizer, param_dtype), members in groups.items():
         cmd = [sys.executable, os.path.join(REPO, "tools", "aot_memory.py"),
                "--model", args.model, "--seq", str(args.seq),
-               "--bs", str(bs), "--gen", gen]
+               "--bs", str(bs), "--gen", gen,
+               "--accum", str(accum), "--optimizer", optimizer,
+               "--param-dtype", param_dtype]
         if gc:
             policies = []
             for _, shape in members:

@@ -15,14 +15,23 @@ accounting differs:
   memory_chunked (reference-compat alias: 1f1b) : chunked accumulation in groups of pp microbatches
           => 1F1B's O(pp) boundary memory, at bubble fraction
           (pp-1)/(2*pp-1) per chunk.
+  interleaved (vpp virtual stages per rank, circular ring)
+          => M*vpp + pp - 1 ticks of 1/(pp*vpp)-stack chunks: bubble
+          fraction (pp-1)/(M*vpp+pp-1) — afab's cut ~vpp x; predicted
+          step time (M*vpp+pp-1)/(vpp*(M+pp-1)) of afab's. Costs vpp x
+          boundary-carry memory and p2p volume
+          (pipeline_parallel.interleaved_tick_schedule).
 
-This tool measures steady-state step time for both at a given geometry
-(default pp=4, accum=8 on the virtual CPU mesh) and prints the measured
-ratio next to the predicted tick ratio. Prediction for pp=4, M=8:
-afab 11 fwd + 11 bwd ticks vs chunked 2x(7 + 7) = 28 -> ~1.27x slower.
+This tool measures steady-state step time for all three at a given
+geometry (default pp=4, accum=8 on the virtual CPU mesh) and prints the
+measured ratios next to the predicted tick ratios. Prediction for pp=4,
+M=8: afab 11 fwd + 11 bwd ticks vs chunked 2x(7 + 7) = 28 -> ~1.27x
+slower; interleaved vpp=2: 19 chunk-ticks vs afab 11 stage-ticks ->
+19/22 = ~0.86x (13.6% faster). The model runs pp*vpp layers so every
+engine shares the exact same network.
 
 Usage (any host; forces the virtual CPU mesh unless --native):
-    python tools/pp_schedule_compare.py [--pp 4] [--accum 8] [--steps 5]
+    python tools/pp_schedule_compare.py [--pp 4] [--accum 8] [--vpp 2]
 """
 
 from __future__ import annotations
@@ -41,6 +50,8 @@ def main() -> None:
     ap.add_argument("--pp", type=int, default=4)
     ap.add_argument("--dp", type=int, default=2)
     ap.add_argument("--accum", type=int, default=8)
+    ap.add_argument("--vpp", type=int, default=2,
+                    help="virtual stages per rank for the interleaved row")
     ap.add_argument("--seq", type=int, default=512)
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--warmup", type=int, default=2)
@@ -65,39 +76,62 @@ def main() -> None:
         jax.config.update("jax_platforms", "cpu")
 
     from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
+    from scaletorch_tpu.parallel.pipeline_parallel import (
+        interleaved_tick_schedule,
+    )
 
+    # every engine runs the SAME network: pp*vpp layers (the interleaved
+    # divisibility requirement, satisfied trivially by the others)
+    n_layers = args.pp * args.vpp
     results = {}
-    for engine in ("afab", "memory_chunked"):
+    for engine in ("afab", "memory_chunked", "interleaved"):
+        extra = {"num_hidden_layers": n_layers}
+        if engine == "interleaved":
+            extra["pp_virtual_stages"] = args.vpp
         cfg = make_bench_args(
             args.model, seq=args.seq, pp=args.pp, dp=args.dp,
             grad_accum=args.accum, pp_engine=engine, dtype="float32",
+            extra=extra,
         )
         r = benchmark_config(cfg, warmup=args.warmup, steps=args.steps)
         results[engine] = r
         print(f"{engine}: step_time={r['step_time_s']}s "
               f"tok/s={r['tokens_per_second']}", flush=True)
 
-    m, pp = args.accum, args.pp
+    m, pp, vpp = args.accum, args.pp, args.vpp
+    iacct = interleaved_tick_schedule(m, pp, vpp)
     pred = {
         "afab_ticks": 2 * (m + pp - 1),
         "afab_bubble": (pp - 1) / (m + pp - 1),
         "chunked_ticks": (m // pp) * 2 * (2 * pp - 1),
         "chunked_bubble": (pp - 1) / (2 * pp - 1),
+        "interleaved_ticks": 2 * iacct["ticks"],
+        "interleaved_bubble": iacct["bubble_fraction"],
     }
     measured_ratio = (
         results["memory_chunked"]["step_time_s"] / results["afab"]["step_time_s"]
     )
     predicted_ratio = pred["chunked_ticks"] / pred["afab_ticks"]
+    measured_inter = (
+        results["interleaved"]["step_time_s"] / results["afab"]["step_time_s"]
+    )
     out = {
-        "geometry": {"pp": pp, "dp": args.dp, "accum": m, "seq": args.seq},
+        "geometry": {"pp": pp, "dp": args.dp, "accum": m, "seq": args.seq,
+                     "vpp": vpp, "num_hidden_layers": n_layers},
         "afab": results["afab"],
         "memory_chunked": results["memory_chunked"],
+        "interleaved": results["interleaved"],
         "predicted": pred,
         "measured_slowdown_chunked_vs_afab": round(measured_ratio, 3),
         "predicted_slowdown_chunked_vs_afab": round(predicted_ratio, 3),
+        "measured_interleaved_vs_afab": round(measured_inter, 3),
+        "predicted_interleaved_vs_afab": round(
+            iacct["relative_step_time"], 3),
         "recommendation": (
-            "afab (1F1B-equivalent bubble, more boundary-activation memory); "
-            "use memory_chunked only when O(accum) boundary carries do not fit"
+            "interleaved when num_hidden_layers % (pp*vpp) == 0 and the "
+            "vpp x boundary-carry memory fits (bubble cut ~vpp x); afab "
+            "otherwise; memory_chunked only when O(accum) boundary carries "
+            "do not fit"
         ),
     }
     print(json.dumps(out, indent=1))
