@@ -101,6 +101,15 @@ SINGLE_CHIP_ROWS = {
 
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "out of memory", "OOM")
 
+# MoE dispatch wall-clock A/B (VERDICT r4 #3): the einsum-vs-index token
+# movement, measured at a config AOT-verified to fit one v5e (moe-mid,
+# 9.4 GB upper bound — tools/bench_moe_dispatch.py). One driver
+# invocation settles whether the 2.65x compiled-FLOPs win
+# (AOT_30B_A3B.json) survives contact with silicon.
+MOE_AB_MODEL = os.environ.get("BENCH_MOE_AB_MODEL", "moe-mid")
+MOE_AB_SHAPE = dict(seq=int(os.environ.get("BENCH_MOE_AB_SEQ", 4096)),
+                    gc=True)
+
 # Tests monkeypatch this to substitute a fake child.
 CHILD_ARGV = [sys.executable, os.path.abspath(__file__)]
 
@@ -183,6 +192,7 @@ def _run_child(env_overrides: dict, budget_s: int, label: str) -> ChildResult:
     # would break the driver contract downstream).
     env["BENCH_PREFLIGHT"] = "0"
     env["BENCH_ROW"] = ""
+    env["BENCH_MOE_AB"] = ""
     env.update({k: str(v) for k, v in env_overrides.items()})
     with tempfile.TemporaryFile(mode="w+") as out, \
             tempfile.TemporaryFile(mode="w+") as err:
@@ -387,6 +397,50 @@ def run_row(label: str, warmup: int, steps: int) -> dict:
     }
 
 
+def _ab_summary(table: dict) -> dict | None:
+    """Ratio of the two A/B legs' step times, or None when either leg is
+    missing/errored (a failed leg must never fabricate a speedup). The
+    2.65x compiled-FLOPs prediction is attached only for the config it
+    was computed at (moe-mid, AOT_30B_A3B.json) — an overridden A/B model
+    measures against no prediction."""
+    ab_e = table.get("moe_dispatch_einsum", {})
+    ab_i = table.get("moe_dispatch_index", {})
+    if not ab_e or not ab_i or "error" in ab_e or "error" in ab_i:
+        return None
+    return {
+        "index_speedup_wallclock": round(
+            ab_e["step_time_s"] / ab_i["step_time_s"], 3),
+        "config": f"{MOE_AB_MODEL} seq{MOE_AB_SHAPE['seq']} gc",
+        **({"compiled_flops_prediction": 2.65}
+           if MOE_AB_MODEL == "moe-mid" and MOE_AB_SHAPE["seq"] == 4096
+           else {}),
+    }
+
+
+def run_moe_dispatch(mode: str, warmup: int, steps: int) -> dict:
+    """One leg of the dispatch A/B: moe-mid with the given token-movement
+    form. The parent computes the ratio of the two legs' step times."""
+    _mark("start")
+    import jax
+
+    jax.local_devices()
+    _mark("backend_up")
+    from scaletorch_tpu.benchmark import benchmark_config, make_bench_args
+
+    cfg = make_bench_args(MOE_AB_MODEL, **MOE_AB_SHAPE,
+                          extra={"moe_dispatch": mode})
+    r = benchmark_config(cfg, warmup=warmup, steps=steps, progress=_mark)
+    _mark("done")
+    return {
+        "metric": f"moe_dispatch_{mode}",
+        "step_time_s": r["step_time_s"],
+        "tokens_per_second": r["tokens_per_second"],
+        "mfu": r["mfu"],
+        "memory_gb": r["memory_gb"],
+        "device": jax.local_devices()[0].device_kind,
+    }
+
+
 # --------------------------------------------------------------------------
 # Parent orchestration (never touches JAX)
 # --------------------------------------------------------------------------
@@ -519,26 +573,51 @@ def run_headline() -> int:
     extra_env = ({"FLASH_ATTEN": "1", "SCALETORCH_TPU_DISABLE_PALLAS": "0"}
                  if pallas_won
                  else {"SCALETORCH_TPU_DISABLE_PALLAS": "1"})
-    for label in ("qwen3-0.6b_seq16384_bs1_gc", "qwen3-0.6b_seq2048_bs4_ga2",
-                  "qwen3-0.6b_seq2048_bs2", "qwen3-1.7b_seq8192_bs1_gc",
-                  "qwen3-1.7b_seq2048_bs1", "qwen3-4b_seq2048_bs1_gc"):
+
+    def _measure(label: str, env: dict, budget_key: str) -> bool:
+        """One budgeted phase-3 child into the table. Returns False when
+        the phase should END (wedge, no budget, or a timeout — even a
+        late_exit row means every further child pays budget + stop ladder
+        on a degraded chip)."""
+        nonlocal chip_wedged
         remaining = deadline - time.perf_counter()
         if chip_wedged or remaining < 400:
-            break
-        res = _run_child(dict(extra_env, BENCH_ROW=label),
-                         min(_budget("BENCH_EXTRA_ROW_BUDGET", 420),
-                             int(remaining) - 90), label)
+            return False
+        res = _run_child(env, min(_budget(budget_key, 420),
+                                  int(remaining) - 90), label)
         chip_wedged = res.wedged
         if res.payload is not None:
             table[label] = res.payload
         else:
             table[label] = {"metric": label, "error": res.error}
         _dump_table(table)
-        if res.timed_out:
-            # even a late_exit row (payload printed, teardown overran)
-            # means every further row pays budget + stop ladder on a
-            # degraded chip — keep what we have and stop
-            break
+        return not res.timed_out
+
+    # priority order (VERDICT): the seq-16384 row (reference's 56.0% best)
+    # first, then the MoE dispatch wall-clock A/B, then the rest of the
+    # single-chip table.
+    go = _measure("qwen3-0.6b_seq16384_bs1_gc",
+                  dict(extra_env, BENCH_ROW="qwen3-0.6b_seq16384_bs1_gc"),
+                  "BENCH_EXTRA_ROW_BUDGET")
+    if go:
+        for mode in ("einsum", "index"):
+            go = _measure(f"moe_dispatch_{mode}",
+                          dict(extra_env, BENCH_MOE_AB=mode),
+                          "BENCH_MOE_AB_BUDGET")
+            if not go:
+                break
+        ab = _ab_summary(table)
+        if ab is not None:
+            table["moe_dispatch_ab"] = ab
+            best["moe_dispatch_index_speedup"] = ab["index_speedup_wallclock"]
+            _dump_table(table)
+    if go:
+        for label in ("qwen3-0.6b_seq2048_bs4_ga2", "qwen3-0.6b_seq2048_bs2",
+                      "qwen3-1.7b_seq8192_bs1_gc", "qwen3-1.7b_seq2048_bs1",
+                      "qwen3-4b_seq2048_bs1_gc"):
+            if not _measure(label, dict(extra_env, BENCH_ROW=label),
+                            "BENCH_EXTRA_ROW_BUDGET"):
+                break
     best["bench_wall_s"] = round(time.perf_counter() - t_start, 1)
     best["rows_measured"] = sum(1 for v in table.values() if "error" not in v)
     print(json.dumps(best))
@@ -546,16 +625,20 @@ def run_headline() -> int:
 
 
 def run_table() -> int:
-    """--table: every single-chip row, one budgeted subprocess each."""
+    """--table: every single-chip row + the MoE dispatch A/B, one
+    budgeted subprocess each."""
     results = {}
     wedged = False
     row_budget = _budget("BENCH_TABLE_ROW_BUDGET", 780)
-    for label in SINGLE_CHIP_ROWS:
+    ab_children = {f"moe_dispatch_{m}": {"BENCH_MOE_AB": m}
+                   for m in ("einsum", "index")}
+    for label in list(SINGLE_CHIP_ROWS) + list(ab_children):
         if wedged:
             results[label] = {"metric": label,
                               "error": "skipped: chip wedged by an earlier row"}
         else:
-            res = _run_child({"BENCH_ROW": label}, row_budget, label)
+            env = ab_children.get(label, {"BENCH_ROW": label})
+            res = _run_child(env, row_budget, label)
             if res.payload is not None:
                 results[label] = res.payload
             else:
@@ -565,6 +648,10 @@ def run_table() -> int:
             results[label]["wall_s"] = res.wall_s
             wedged = res.wedged
         print(json.dumps(results[label]), file=sys.stderr, flush=True)
+        _dump_table(results)
+    ab = _ab_summary(results)
+    if ab is not None:
+        results["moe_dispatch_ab"] = ab
         _dump_table(results)
     head = results.get(HEADLINE, {})
     if "error" in head:
@@ -586,7 +673,8 @@ def main() -> int:
         return run_table()
 
     # Child modes next: they are the only paths that import JAX.
-    if os.environ.get("BENCH_PREFLIGHT") == "1" or os.environ.get("BENCH_ROW"):
+    if (os.environ.get("BENCH_PREFLIGHT") == "1" or os.environ.get("BENCH_ROW")
+            or os.environ.get("BENCH_MOE_AB")):
         # stdout must carry ONLY the result JSON (parent parses the last
         # line): move the framework logger's streams to stderr.
         import logging
@@ -598,6 +686,15 @@ def main() -> int:
                 h.setStream(sys.stderr)
     if os.environ.get("BENCH_PREFLIGHT") == "1":
         print(json.dumps(run_preflight()))
+        return 0
+    if os.environ.get("BENCH_MOE_AB"):
+        mode = os.environ["BENCH_MOE_AB"]
+        if mode not in ("einsum", "index"):
+            raise KeyError(f"BENCH_MOE_AB {mode!r} must be einsum|index")
+        print(json.dumps(run_moe_dispatch(
+            mode,
+            int(os.environ.get("BENCH_WARMUP_STEPS", 2)),
+            int(os.environ.get("BENCH_STEPS", 8)))))
         return 0
     if os.environ.get("BENCH_ROW"):
         warmup = int(os.environ.get("BENCH_WARMUP_STEPS", 3))

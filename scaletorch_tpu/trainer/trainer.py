@@ -616,12 +616,26 @@ class Trainer:
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()
 
+    def _layer_storage(self) -> str:
+        """Identity of the stacked-layer STORAGE order this run trains in.
+        The interleaved engine permutes the layer axis with unchanged
+        shapes, so a resume across engines cannot be caught by any shape
+        check — this string is saved with every checkpoint and validated
+        on load."""
+        cfg = self.cfg
+        if (cfg.pipeline_parallel_size > 1
+                and cfg.pp_engine == "interleaved"):
+            return (f"interleaved_pp{cfg.pipeline_parallel_size}"
+                    f"_vpp{cfg.pp_virtual_stages}")
+        return "model_order"
+
     def save_checkpoint(self) -> None:
         self.checkpoint_manager.save(
             step=self.global_step,
             params=self.params,
             opt_state=self.opt_state,
-            extra={"tokens_seen": self.tokens_seen},
+            extra={"tokens_seen": self.tokens_seen,
+                   "layer_storage": self._layer_storage()},
         )
 
     def load_checkpoint(self) -> None:
@@ -634,6 +648,20 @@ class Trainer:
                 f"{self.cfg.checkpoint_dir}; training from scratch"
             )
             return
+        # note: uneven-PP padding IS shape-checked by orbax's template
+        # restore; only the shape-preserving interleave permutation needs
+        # this metadata. Checkpoints predating the field trained in model
+        # order, so the default makes them refuse an interleaved resume.
+        saved_storage = restored["extra"].get("layer_storage", "model_order")
+        if saved_storage != self._layer_storage():
+            raise ValueError(
+                f"checkpoint stores layers in {saved_storage!r} order but "
+                f"this run uses {self._layer_storage()!r} "
+                f"(pp_engine={self.cfg.pp_engine}, "
+                f"pp_virtual_stages={self.cfg.pp_virtual_stages}): resume "
+                "with the original engine settings, or export/convert via "
+                "pipeline_parallel.deinterleave_stacked_params first"
+            )
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.global_step = restored["step"]

@@ -27,13 +27,18 @@ FAKE_CHILD = textwrap.dedent(
     import json, os, signal, sys, time
 
     spec = json.loads(os.environ["FAKE_SPEC"])
-    if os.environ.get("BENCH_PREFLIGHT") == "1":
+    ab = os.environ.get("BENCH_MOE_AB") or None
+    if ab:
+        mode = "moe_" + ab
+    elif os.environ.get("BENCH_PREFLIGHT") == "1":
         mode = "preflight"
     elif os.environ.get("SCALETORCH_TPU_DISABLE_PALLAS") == "1":
         mode = "sdpa_row"
     else:
         mode = "pallas_row"
-    beh = spec[mode]
+    # A/B legs default to a fast ok (speedup 1.0) so specs written for
+    # the attention-path tests keep passing with the dispatch phase on.
+    beh = spec[mode] if not ab else spec.get(mode, "ok")
 
     def mark(stage):
         print(json.dumps({"event": "progress", "stage": stage}),
@@ -51,6 +56,13 @@ FAKE_CHILD = textwrap.dedent(
         print(json.dumps({"metric": mode, "error": "boom"}))
         sys.exit(1)
     mark("done")
+    if ab:
+        print(json.dumps({
+            "metric": "moe_dispatch_" + ab,
+            "step_time_s": spec.get(mode + "_step", 1.0),
+            "tokens_per_second": 1000.0, "mfu": 10.0,
+        }), flush=True)
+        sys.exit(0)
     if mode == "preflight":
         print(json.dumps({"preflight": "ok", "step_ms": 1.0}))
     else:
@@ -86,6 +98,7 @@ def fake_bench(tmp_path, monkeypatch):
     monkeypatch.setenv("BENCH_PREFLIGHT_BUDGET", "5")
     monkeypatch.setenv("BENCH_PALLAS_ROW_BUDGET", "5")
     monkeypatch.setenv("BENCH_EXTRA_ROW_BUDGET", "10")
+    monkeypatch.setenv("BENCH_MOE_AB_BUDGET", "10")
 
     def set_spec(**spec):
         monkeypatch.setenv("FAKE_SPEC", json.dumps(spec))
@@ -201,7 +214,8 @@ def test_table_mode_short_circuits_after_wedge(fake_bench, capsys, monkeypatch):
     fake_bench(pallas_row="wedge")
     assert bench.run_table() == 1
     table = json.loads(open("bench_table.json").read())
-    assert len(table) == len(bench.SINGLE_CHIP_ROWS)
+    # every single-chip row + the two dispatch A/B legs, all accounted for
+    assert len(table) == len(bench.SINGLE_CHIP_ROWS) + 2
     statuses = [v.get("error", "") for v in table.values()]
     assert any("budget" in s for s in statuses[:1])
     assert all("skipped: chip wedged" in s for s in statuses[1:])
@@ -255,6 +269,46 @@ def test_extra_rows_stop_after_a_timeout(fake_bench, capsys, monkeypatch):
     extras = [c for c in calls
               if c not in ("sdpa_row", "pallas_preflight", "pallas_row")]
     assert len(extras) == 1
+
+
+def test_moe_dispatch_ab_measured_after_seq16k(fake_bench, capsys,
+                                               monkeypatch):
+    """Phase 3.5: with budget, the einsum/index wall-clock A/B runs right
+    after the priority seq-16384 row and the headline line carries the
+    measured index speedup (the on-chip verdict on the 2.65x
+    compiled-FLOPs claim)."""
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "100000")
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4, preflight="error",
+               moe_einsum="ok", moe_einsum_step=2.4,
+               moe_index="ok", moe_index_step=1.2)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["moe_dispatch_index_speedup"] == 2.0
+    table = json.loads(open("bench_table.json").read())
+    assert table["moe_dispatch_ab"]["index_speedup_wallclock"] == 2.0
+    # ordering: the A/B must come before the bulk table rows so a tight
+    # window still settles the dispatch question
+    labels = list(table)
+    assert labels.index("moe_dispatch_einsum") < labels.index(
+        "qwen3-0.6b_seq2048_bs2")
+    assert labels.index("qwen3-0.6b_seq16384_bs1_gc") < labels.index(
+        "moe_dispatch_einsum")
+
+
+def test_moe_dispatch_ab_error_leg_skips_ratio(fake_bench, capsys,
+                                               monkeypatch):
+    """A failed A/B leg must not fabricate a speedup; the remaining table
+    rows still run."""
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "100000")
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4, preflight="error",
+               moe_einsum="error", moe_index="ok", moe_index_step=1.2)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert "moe_dispatch_index_speedup" not in line
+    table = json.loads(open("bench_table.json").read())
+    assert "moe_dispatch_ab" not in table
+    assert "error" in table["moe_dispatch_einsum"]
+    assert "qwen3-0.6b_seq2048_bs2" in table  # bulk rows still measured
 
 
 def test_stale_child_mode_env_cannot_hijack_children(fake_bench, capsys,

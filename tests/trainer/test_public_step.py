@@ -13,15 +13,17 @@ from scaletorch_tpu.config import ScaleTorchTPUArguments
 
 
 def _cfg(**kw):
-    return ScaleTorchTPUArguments(
+    defaults = dict(
         model_type="llama", hidden_size=32, intermediate_size=64,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         vocab_size=64, sequence_length=16, max_position_embeddings=32,
         data_parallel_size=8, micro_batch_size=1,
         gradient_accumulation_steps=2, synthetic_data=True,
         total_train_steps=8, dtype="float32", donate_params=False,
-        log_frequency=100, **kw,
+        log_frequency=100,
     )
+    defaults.update(kw)
+    return ScaleTorchTPUArguments(**defaults)
 
 
 @pytest.mark.slow
@@ -44,6 +46,35 @@ def test_step_counts_actual_batch_tokens():
         )
     finally:
         t.close()
+
+
+@pytest.mark.slow
+def test_resume_across_pp_engines_refuses_scrambled_layers(tmp_path):
+    """The interleave permutation preserves shapes, so resuming an afab
+    checkpoint under pp_engine='interleaved' (or vice versa) can only be
+    caught by the layer_storage metadata — it must raise, not silently
+    train a scrambled layer stack (code-review r5)."""
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    def cfg(**kw):
+        return _cfg(num_hidden_layers=4, pipeline_parallel_size=2,
+                    data_parallel_size=4, checkpoint_dir=str(tmp_path), **kw)
+
+    t = Trainer(cfg())
+    try:
+        t.step()
+        t.save_checkpoint()
+        t._ckpt_mgr.wait()
+    finally:
+        t.close()
+
+    t2 = Trainer(cfg(pp_engine="interleaved", pp_virtual_stages=2,
+                     resume_from_checkpoint=True))
+    try:
+        with pytest.raises(ValueError, match="layer_storage|order"):
+            t2.load_checkpoint()
+    finally:
+        t2.close()
 
 
 @pytest.mark.slow
