@@ -97,8 +97,10 @@ class ModelArguments:
         metadata={"help": "auto | einsum | index — capacity-dispatch token "
                           "movement. einsum = GShard one-hot (dense MXU, "
                           "O(N·E·C·H)); index = scatter/gather of the "
-                          "O(N·k·H) moving rows (wins at large E). auto "
-                          "picks index once num_experts > 16."},
+                          "O(N·k·H) moving rows. auto picks index at every "
+                          "expert count (the one-hot cost is E-independent "
+                          "and always the larger compile — "
+                          "AOT_DISPATCH_CROSSOVER.json)."},
     )
     # Interleaved dense/sparse architecture (HF Qwen3MoeConfig knobs):
     # layer i is sparse iff i not in mlp_only_layers and (i+1) %

@@ -54,7 +54,8 @@ class GPTMoEConfig:
     router_noise_std: float = 1.0  # noisy top-k (moe.py noisy routing)
     norm_topk_prob: bool = True
     # einsum | index token movement (see expert_parallel.route_tokens);
-    # auto picks index once num_experts > 16, like Qwen3MoEConfig
+    # auto picks index at every E, like Qwen3MoEConfig
+    # (AOT_DISPATCH_CROSSOVER.json: the one-hot cost never wins)
     moe_dispatch: str = "auto"
     dtype: Any = jnp.float32
 

@@ -75,8 +75,10 @@ class Qwen3MoEConfig(Qwen3Config):
     # GShard one-hot einsums (dense MXU work, O(N·E·C·H) MACs — fine at
     # small E); 'index' = scatter/gather of exactly the O(N·k·H) moving
     # rows (at Qwen3-30B-A3B scale, E=128/top-8, the one-hot einsums cost
-    # ~4.5x the expert matmuls themselves). 'auto' picks 'index' once
-    # E > 16. Both compute identical math (same drops, same weights).
+    # ~4.5x the expert matmuls themselves). 'auto' picks 'index' at every
+    # expert count — the one-hot cost is E-independent (E*C = N*k*cf) and
+    # always the larger compile (AOT_DISPATCH_CROSSOVER.json). Both
+    # compute identical math (same drops, same weights).
     moe_dispatch: str = "auto"
     # Slot-skipping Pallas expert kernel (ops/pallas/grouped_mlp.py). The
     # env toggle is read ONCE, at config construction (host side) — never
@@ -290,8 +292,9 @@ def moe_block(
     )
     # Mode-aware movement API (expert_parallel.route_tokens & co):
     # 'einsum' = GShard one-hot, 'index' = O(N·k·H) scatter/gather —
-    # identical math; 'auto' resolves by expert count (the one-hot
-    # einsums dominate step FLOPs at large E — AOT_30B_A3B.json).
+    # identical math; 'auto' resolves to index at every expert count
+    # (the one-hot cost is E-independent and always the larger compile —
+    # AOT_DISPATCH_CROSSOVER.json, resolve_moe_dispatch).
     mode = cfg.resolved_moe_dispatch()
     state, aux = jax.vmap(
         lambda lg: route_tokens(

@@ -362,14 +362,22 @@ def gather_tokens_indexed(
 
 
 def resolve_moe_dispatch(mode: str, num_experts: int) -> str:
-    """'auto' -> the form that wins at this expert count. The crossover
-    is where the one-hot O(N·E·C·H) einsums start dominating the expert
-    matmuls (AOT cost analysis, AOT_30B_A3B.json; retune here — and only
-    here — after on-chip tools/bench_moe_dispatch.py measurements)."""
+    """'auto' -> the form the evidence favors at this expert count.
+
+    AOT_DISPATCH_CROSSOVER.json (XLA cost analysis of the full train
+    step, E swept 4..64): the one-hot einsums' O(N*E*C*H) cost is
+    E-INDEPENDENT at fixed capacity factor (E*C = N*k*cf), a flat ~25%
+    FLOP overhead that the index form avoids at EVERY expert count —
+    there is no compiled-FLOP crossover; index wins from E=4 up. CPU
+    wall-clock mechanics agree at E=8 (1.19x). 'auto' therefore always
+    picks index; 'einsum' stays selectable for A/B runs
+    (tools/bench_moe_dispatch.py, bench.py phase 3.5) and as a fallback
+    should silicon ever disagree (scatter/gather can be memory-bound
+    where einsum is MXU-bound — the wall-clock A/B is the final word)."""
     _check_mode(mode, allow_auto=True)
     if mode != "auto":
         return mode
-    return "index" if num_experts > 16 else "einsum"
+    return "index"
 
 
 def _check_mode(mode: str, allow_auto: bool = False) -> None:

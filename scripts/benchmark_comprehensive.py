@@ -57,6 +57,8 @@ CORRECTNESS_CONFIGS = [
     ("tiny-PP4-DP2-1f1b",    "dense-tiny", 1, 4, 2, 1, 1, 2, 4, 256, False, False, "memory_chunked"),
     ("tiny-PP2-VPP2-DP4",    "dense-tiny", 1, 2, 4, 1, 1, 2, 4, 256, False, False, "interleaved",
      {"pp_virtual_stages": 2}),  # virtual-stage circular pipeline (L=4 = pp*vpp)
+    ("tiny-PP2-VPP2-CP2-GC", "dense-tiny", 1, 2, 2, 2, 1, 1, 2, 512, True, False, "interleaved",
+     {"pp_virtual_stages": 2}),  # interleaved x ring-attention composition
     # --- CP (ring runs the zigzag layout by default; ulysses = the
     # all-to-all head-scatter strategy) ---
     ("tiny-CP2-DP4",         "dense-tiny", 1, 1, 4, 2, 1, 1, 1, 512, False, False, "memory_chunked"),
@@ -75,14 +77,19 @@ CORRECTNESS_CONFIGS = [
     ("moe-EP2-DP4",          "moe-tiny",   1, 1, 4, 1, 2, 1, 1, 256, False, False, "memory_chunked"),
     ("moe-EP4-DP2",          "moe-tiny",   1, 1, 2, 1, 4, 1, 1, 256, False, False, "memory_chunked"),
     ("moe-EP2-TP2-DP2",      "moe-tiny",   2, 1, 2, 1, 2, 1, 1, 256, False, False, "memory_chunked"),
-    ("moe-EP2-DP4-index",    "moe-tiny",   1, 1, 4, 1, 2, 1, 1, 256, False, False, "memory_chunked",
-     {"moe_dispatch": "index"}),
+    # auto now resolves to index everywhere (AOT_DISPATCH_CROSSOVER.json),
+    # so the base moe rows attest the index path; this row keeps the
+    # einsum form attested.
+    ("moe-EP2-DP4-einsum",   "moe-tiny",   1, 1, 4, 1, 2, 1, 1, 256, False, False, "memory_chunked",
+     {"moe_dispatch": "einsum"}),
     ("moe-interleaved-EP2-DP4", "moe-tiny", 1, 1, 4, 1, 2, 1, 1, 256, False, False, "memory_chunked",
      {"decoder_sparse_step": 2}),  # layers 1,3 sparse / 0,2 dense
     ("moe-EP2-CP2-DP2",      "moe-tiny",   1, 1, 2, 2, 2, 1, 1, 512, False, False, "memory_chunked"),
     ("moe-EP2-TP2-CP2-GC",   "moe-tiny",   2, 1, 1, 2, 2, 1, 1, 512, True,  False, "memory_chunked"),
     # --- PP x EP (MoE pipeline; VERDICT r1 missing #8) ---
     ("moe-PP2-EP2-DP2",      "moe-tiny",   1, 2, 2, 1, 2, 1, 2, 256, False, False, "afab"),
+    ("moe-PP2-VPP2-EP2-DP2", "moe-tiny",   1, 2, 2, 1, 2, 1, 2, 256, False, False, "interleaved",
+     {"pp_virtual_stages": 2}),  # expert all-to-all inside switch chunks
     ("moe-PP2-EP2-TP2-1f1b", "moe-tiny",   2, 2, 1, 1, 2, 1, 2, 256, False, False, "memory_chunked"),
 ]
 

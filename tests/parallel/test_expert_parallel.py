@@ -449,11 +449,14 @@ class TestIndexedDispatch:
     def test_auto_resolution(self):
         import dataclasses
 
-        assert CFG.resolved_moe_dispatch() == "einsum"  # E=8
+        # auto -> index at EVERY expert count: the einsum dispatch FLOPs
+        # are E-independent (E*C = N*k*cf) and always the larger compile
+        # (AOT_DISPATCH_CROSSOVER.json, swept E=4..64)
+        assert CFG.resolved_moe_dispatch() == "index"  # E=8
         big = dataclasses.replace(CFG, num_experts=32)
         assert big.resolved_moe_dispatch() == "index"
-        pinned = dataclasses.replace(CFG, moe_dispatch="index")
-        assert pinned.resolved_moe_dispatch() == "index"
+        pinned = dataclasses.replace(CFG, moe_dispatch="einsum")
+        assert pinned.resolved_moe_dispatch() == "einsum"
         with pytest.raises(ValueError, match="moe_dispatch"):
             dataclasses.replace(CFG, moe_dispatch="scatter")
 

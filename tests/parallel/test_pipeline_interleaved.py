@@ -78,6 +78,21 @@ def simulate_schedule(m, pp, vpp):
     return occupancy, finish
 
 
+def expected_occupancy(t, r, m, pp, vpp):
+    """The traced tick loop's index math, in one place for both the
+    hand-picked and randomized simulator cross-checks: (mb, vstage) live
+    at (tick, rank), or None."""
+    period = pp * vpp
+    u = t - r
+    u_c = max(u, 0)
+    w = u_c % period
+    c = w // pp
+    mb = (u_c // period) * pp + (w % pp)
+    if u >= 0 and mb < m:
+        return (mb, c * pp + r)
+    return None
+
+
 class TestSchedule:
     @pytest.mark.parametrize("m,pp,vpp", [
         (2, 2, 2), (4, 2, 2), (8, 4, 2), (8, 2, 4), (3, 2, 2), (6, 4, 3),
@@ -97,20 +112,11 @@ class TestSchedule:
         """The (chunk, microbatch, live) formulas the traced tick loop uses
         must reproduce the simulator's occupancy exactly."""
         occupancy, finish = simulate_schedule(m, pp, vpp)
-        period = pp * vpp
-        total_ticks = finish[-1] + 1 if m % pp == 0 else max(finish) + 1
+        total_ticks = max(finish) + 1
         for t in range(total_ticks):
             for r in range(pp):
-                u = t - r
-                u_c = max(u, 0)
-                w = u_c % period
-                c = w // pp
-                mb = (u_c // period) * pp + (w % pp)
-                live = (u >= 0) and (mb < m)
-                if live:
-                    assert occupancy.get((t, r)) == (mb, c * pp + r), (t, r)
-                else:
-                    assert (t, r) not in occupancy
+                assert occupancy.get((t, r)) == expected_occupancy(
+                    t, r, m, pp, vpp), (t, r)
 
     def test_bubble_accounting(self):
         # M=8, pp=4: afab bubble 3/11; vpp=2 cuts it to 3/19 with step time
@@ -129,6 +135,23 @@ class TestSchedule:
             assert cur["bubble_fraction"] < prev["bubble_fraction"]
             assert cur["relative_step_time"] < prev["relative_step_time"]
             prev = cur
+
+    def test_randomized_schedule_space(self):
+        """Property sweep: 200 random (m, pp, vpp) triples — the traced
+        index math must match the simulator everywhere, not just the
+        hand-picked cases (insurance against off-by-ones in corners like
+        m < pp or vpp > m)."""
+        rng = np.random.default_rng(7)
+        for _ in range(200):
+            pp = int(rng.integers(1, 6))
+            vpp = int(rng.integers(2, 5))
+            m = int(rng.integers(1, 13))
+            occupancy, finish = simulate_schedule(m, pp, vpp)
+            assert finish == interleaved_finish_ticks(m, pp, vpp), (m, pp, vpp)
+            for t in range(max(finish) + 1):
+                for r in range(pp):
+                    assert occupancy.get((t, r)) == expected_occupancy(
+                        t, r, m, pp, vpp), (m, pp, vpp, t, r)
 
     def test_validation(self):
         validate_interleaved_divisibility(8, 2, 2)
@@ -381,6 +404,114 @@ class TestInterleavedMoE:
         assert float(m["loss"]) == pytest.approx(float(m_ref["loss"]), rel=5e-6)
         assert np.isfinite(float(m["moe_load_cv"]))
         assert 0.0 <= float(m["moe_dropped_fraction"]) <= 1.0
+
+
+@pytest.mark.slow
+class TestInterleavedComposition:
+    """The engine must compose with the other mesh axes exactly like
+    afab does: CP (ring attention inside chunk compute, sequence-sharded
+    carries) and EP (expert all-to-all inside lax.switch branches —
+    sound because ep groups never span pp, so a group always takes the
+    same branch together)."""
+
+    def test_with_cp_zigzag_ring(self):
+        from scaletorch_tpu.config import ScaleTorchTPUArguments
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
+        from scaletorch_tpu.parallel.zigzag import zigzag_batch
+        from scaletorch_tpu.trainer.optimizer import create_optimizer
+        from scaletorch_tpu.trainer.train_step import make_train_step
+
+        params = init_params(jax.random.PRNGKey(0), CFG)
+        rng = np.random.default_rng(0)
+        accum, bsz, seq = 2, 2, 32  # seq % (2*cp) == 0
+        ids = rng.integers(0, CFG.vocab_size, (accum, bsz, seq + 1))
+        batch = {
+            "input_ids": ids[:, :, :-1].astype(np.int32),
+            "target_ids": ids[:, :, 1:].astype(np.int32),
+            "position_ids": np.broadcast_to(
+                np.arange(seq, dtype=np.int32), (accum, seq)
+            ).copy(),
+        }
+        tcfg = ScaleTorchTPUArguments(
+            learning_rate=1e-3, total_train_steps=10, warmup_steps=0
+        )
+        tx_ref, _ = create_optimizer(tcfg, include_clip=False)
+        ref_step = make_train_step(forward, CFG, tx_ref, donate=False)
+        _, _, m_ref = ref_step(params, tx_ref.init(params), batch)
+
+        mm = MeshManager(pp=2, cp=2, dp=2)
+        p_host = dict(params, layers=interleave_stacked_params(
+            params["layers"], CFG.num_hidden_layers, mm.pp, 2))
+        tx, _ = create_optimizer(tcfg, include_clip=False)
+        step_fn, p_specs, o_specs = make_spmd_train_step(
+            mm, forward, CFG, tx, p_host,
+            attention_backend="ring", cp_layout="zigzag",
+            max_grad_norm=0.0, donate=False,
+            pp_schedule="interleaved", pp_vpp=2,
+        )
+        _, _, m = step_fn(
+            shard_params(mm, p_host, p_specs),
+            shard_params(mm, tx.init(p_host), o_specs),
+            zigzag_batch(batch, mm.cp),
+        )
+        assert float(m["loss"]) == pytest.approx(float(m_ref["loss"]), rel=2e-5)
+
+    def test_with_ep_all_to_all(self):
+        from scaletorch_tpu.config import ScaleTorchTPUArguments
+        from scaletorch_tpu.models.qwen3_moe import (
+            Qwen3MoEConfig,
+            forward as moe_forward,
+            init_params as moe_init,
+            qwen3_moe_param_specs,
+        )
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
+        from scaletorch_tpu.trainer.optimizer import create_optimizer
+        from scaletorch_tpu.trainer.train_step import make_train_step
+
+        cfg = Qwen3MoEConfig(
+            vocab_size=128, hidden_size=32, intermediate_size=64,
+            moe_intermediate_size=48, num_hidden_layers=4,
+            num_attention_heads=4, num_key_value_heads=4, head_dim=8,
+            num_experts=4, num_experts_per_tok=2, capacity_factor=8.0,
+            dtype=jnp.float32, qk_norm=True, tie_word_embeddings=False,
+        )
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        rng = np.random.default_rng(0)
+        accum, bsz, seq = 2, 4, 16
+        ids = rng.integers(0, cfg.vocab_size, (accum, bsz, seq + 1))
+        batch = {
+            "input_ids": ids[:, :, :-1].astype(np.int32),
+            "target_ids": ids[:, :, 1:].astype(np.int32),
+            "position_ids": np.broadcast_to(
+                np.arange(seq, dtype=np.int32), (accum, seq)
+            ).copy(),
+        }
+        tcfg = ScaleTorchTPUArguments(
+            learning_rate=1e-3, total_train_steps=10, warmup_steps=0
+        )
+        tx_ref, _ = create_optimizer(tcfg, include_clip=False)
+        ref_step = make_train_step(moe_forward, cfg, tx_ref, donate=False)
+        _, _, m_ref = ref_step(params, tx_ref.init(params), batch)
+
+        mm = MeshManager(pp=2, ep=2, dp=2)
+        p_host = dict(params, layers=interleave_stacked_params(
+            params["layers"], 4, mm.pp, 2))
+        tx, _ = create_optimizer(tcfg, include_clip=False)
+        specs = qwen3_moe_param_specs(
+            cfg, tp_axis="tp", ep_axis="ep", pp_axis="pp")
+        step_fn, p_specs, o_specs = make_spmd_train_step(
+            mm, moe_forward, cfg, tx, p_host,
+            max_grad_norm=0.0, donate=False, param_specs=specs,
+            model_kwargs={"ep_axis": "ep"},
+            model_family="qwen3_moe", pp_schedule="interleaved", pp_vpp=2,
+        )
+        _, _, m = step_fn(
+            shard_params(mm, p_host, p_specs),
+            shard_params(mm, tx.init(p_host), o_specs),
+            batch,
+        )
+        assert float(m["loss"]) == pytest.approx(float(m_ref["loss"]), rel=5e-6)
+        assert np.isfinite(float(m["moe_load_cv"]))
 
 
 class TestStepGuards:

@@ -89,6 +89,38 @@ def test_trainer_evaluate_synthetic():
 
 
 @pytest.mark.slow
+def test_trainer_evaluate_with_interleaved_pp():
+    """The eval step must run the SAME engine as training when
+    pp_engine='interleaved' — an afab eval graph over interleaved-order
+    params would stack the wrong layers per stage. Two trainers on
+    identical data, one per engine: val losses must agree."""
+    def mk(engine, vpp):
+        return ScaleTorchTPUArguments(
+            model_type="llama", hidden_size=32, intermediate_size=64,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, head_dim=16, vocab_size=128,
+            sequence_length=16, max_position_embeddings=64,
+            pipeline_parallel_size=2, data_parallel_size=4,
+            pp_engine=engine, pp_virtual_stages=vpp,
+            synthetic_data=True, total_train_steps=2, dtype="float32",
+            eval_frequency=1, eval_steps=2,
+            donate_params=False, log_frequency=100,
+        )
+
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    vals = {}
+    for engine, vpp in (("afab", 1), ("interleaved", 2)):
+        tr = Trainer(mk(engine, vpp))
+        try:
+            vals[engine] = tr.evaluate()
+        finally:
+            tr.close()
+    assert np.isfinite(vals["interleaved"])
+    assert vals["interleaved"] == pytest.approx(vals["afab"], rel=1e-5)
+
+
+@pytest.mark.slow
 def test_trainer_bf16_master_weights():
     """param_dtype=bfloat16 (torch-parity memory mode, bench 1.7B/4B rows):
     params AND adam moments stay bf16 across jitted steps — a dtype drift
