@@ -659,8 +659,8 @@ class Trainer:
                 f"this run uses {self._layer_storage()!r} "
                 f"(pp_engine={self.cfg.pp_engine}, "
                 f"pp_virtual_stages={self.cfg.pp_virtual_stages}): resume "
-                "with the original engine settings, or export/convert via "
-                "pipeline_parallel.deinterleave_stacked_params first"
+                "with the original engine settings, or convert the "
+                "checkpoint offline with tools/convert_layer_storage.py"
             )
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]

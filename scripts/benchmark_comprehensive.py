@@ -42,6 +42,12 @@ WARMUP_STEPS = 2
 # Config tables: (label, model, tp, pp, dp, cp, ep, bs, ga, seq, gc, sp, engine)
 # Mirrors the reference CONFIGS tuple layout (benchmark_comprehensive.py:55)
 # with an extra ep column (the reference sweeps EP in run_npu.sh instead).
+#
+# READING THE CORRECTNESS TABLE: on the virtual CPU mesh the SIGNAL is
+# the loss column (every config must land on the same objective) and the
+# OK/FAIL status. tokens_per_sec and wall_s are recorded for the
+# hardware tier only — on a timeshared CPU host they vary by integer
+# factors with machine load and must not be used to rank configs.
 # ---------------------------------------------------------------------------
 
 # fmt: off

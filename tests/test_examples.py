@@ -119,6 +119,30 @@ def test_trainer_points_examples_models_at_their_mains():
         build_model_config(cfg)
 
 
+def test_pipeline_example_all_engines(capsys):
+    """Pipeline demo: every schedule trains to the same decreasing loss
+    on the same data (they reorder compute, not math), and the
+    interleaved run prints its tick accounting."""
+    from examples.pipeline.train_pp import main
+
+    last = {}
+    interleaved_out = ""
+    for engine in ("afab", "interleaved", "memory_chunked"):
+        last[engine] = main([
+            "--engine", engine, "--steps", "6", "--seq", "64",
+        ])
+        out = capsys.readouterr().out
+        if engine == "interleaved":
+            interleaved_out = out
+        first = float(out.split("loss ")[1].split(" ->")[0])
+        assert last[engine] < first  # it actually learns
+    assert last["interleaved"] == pytest.approx(last["afab"], rel=1e-4)
+    assert last["memory_chunked"] == pytest.approx(last["afab"], rel=1e-4)
+    # the tick accounting printed up front
+    assert "predicted step time" in interleaved_out
+    assert "bubble" in interleaved_out
+
+
 def test_moe_example_dispatch_and_interleaved(capsys):
     """MoE demo: learns under the index dispatch AND the interleaved
     dense/sparse architecture, and the two dispatch modes agree exactly

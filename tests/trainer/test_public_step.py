@@ -78,6 +78,84 @@ def test_resume_across_pp_engines_refuses_scrambled_layers(tmp_path):
 
 
 @pytest.mark.slow
+def test_convert_layer_storage_roundtrips_resume(tmp_path):
+    """tools/convert_layer_storage.py is the documented path across the
+    engine boundary: train afab 2 steps + save, convert the checkpoint
+    to interleaved order, resume under pp_engine='interleaved' for 2
+    more steps — final params (deinterleaved) must match an
+    uninterrupted 4-step afab run on the same stream."""
+    import subprocess
+    import sys
+
+    import jax
+
+    from scaletorch_tpu.parallel.pipeline_parallel import (
+        deinterleave_stacked_params,
+    )
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    def cfg(**kw):
+        return _cfg(num_hidden_layers=4, pipeline_parallel_size=2,
+                    data_parallel_size=4, micro_batch_size=4,
+                    total_train_steps=4, **kw)
+
+    # ground truth: uninterrupted afab
+    t_ref = Trainer(cfg())
+    try:
+        for _ in range(4):
+            t_ref.step()
+        ref = jax.device_get(t_ref.params)
+    finally:
+        t_ref.close()
+
+    src = tmp_path / "afab"
+    t1 = Trainer(cfg(checkpoint_dir=str(src)))
+    try:
+        t1.step()
+        t1.step()
+        t1.save_checkpoint()
+        t1._ckpt_mgr.wait()
+    finally:
+        t1.close()
+
+    dst = tmp_path / "vpp2"
+    import os
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))),
+        "tools", "convert_layer_storage.py")
+    proc = subprocess.run(
+        [sys.executable, tool, "--ckpt", str(src), "--out", str(dst),
+         "--to", "interleaved", "--pp", "2", "--vpp", "2"],
+        capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "model_order -> interleaved_pp2_vpp2" in proc.stdout
+
+    t2 = Trainer(cfg(pp_engine="interleaved", pp_virtual_stages=2,
+                     checkpoint_dir=str(dst), resume_from_checkpoint=True))
+    try:
+        t2.load_checkpoint()
+        assert t2.global_step == 2
+        # synthetic stream has no set_state: skip the 2 consumed batches
+        # and feed explicitly (same pattern as the uneven-PP resume test)
+        it = iter(t2.loader)
+        for _ in range(2):
+            next(it)
+        t2.step(batch=next(it))
+        t2.step(batch=next(it))
+        final = jax.device_get(t2.params)
+    finally:
+        t2.close()
+    final = dict(final, layers=deinterleave_stacked_params(
+        final["layers"], 4, 2, 2))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+        final, ref,
+    )
+
+
+@pytest.mark.slow
 def test_load_checkpoint_resets_step_iterator(tmp_path):
     from scaletorch_tpu.trainer.trainer import Trainer
 

@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Convert a training checkpoint between layer storage orders.
+
+``pp_engine='interleaved'`` stores the stacked layer axis in rank-major
+virtual-stage order (pipeline_parallel.interleave_stacked_params); a
+checkpoint saved under one engine cannot resume under another —
+Trainer.load_checkpoint refuses via the ``layer_storage`` metadata and
+points here. This tool rewrites the checkpoint offline:
+
+    python tools/convert_layer_storage.py \
+        --ckpt ckpts --out ckpts_vpp2 --to interleaved --pp 2 --vpp 2
+    python tools/convert_layer_storage.py \
+        --ckpt ckpts_vpp2 --out ckpts_plain --to model_order
+
+The permutation is applied to every stacked-layer leaf in BOTH params
+and optimizer state (adam moments and adafactor factored stats keep the
+layer axis leading, so the same row permutation applies). ``--to
+model_order`` reads pp/vpp from the checkpoint's own metadata.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _permute_layers_subtrees(tree, idx, num_layers):
+    """Apply row permutation ``idx`` to every leaf under any dict key
+    named 'layers' whose leading dim == num_layers. The optimizer state
+    mirrors the params dict structure (mu/nu/factored stats), so the same
+    walk covers it."""
+
+    def walk(node, in_layers):
+        if isinstance(node, dict):
+            return {
+                k: walk(v, in_layers or k == "layers")
+                for k, v in node.items()
+            }
+        if isinstance(node, (list, tuple)):
+            out = [walk(v, in_layers) for v in node]
+            return type(node)(out)
+        if in_layers and hasattr(node, "shape") and node.ndim >= 1:
+            if node.shape[0] != num_layers:
+                raise ValueError(
+                    f"stacked-layer leaf with leading dim {node.shape[0]} != "
+                    f"num_layers {num_layers}: cannot permute a non-uniform "
+                    "stack (interleaved storage requires uniform stacking)"
+                )
+            return node[idx]
+        return node
+
+    return walk(tree, False)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ckpt", required=True, help="source checkpoint dir")
+    ap.add_argument("--out", required=True, help="destination dir (new)")
+    ap.add_argument("--step", type=int, default=None,
+                    help="step to convert (default: latest)")
+    ap.add_argument("--to", required=True,
+                    choices=["interleaved", "model_order"])
+    ap.add_argument("--pp", type=int, default=None,
+                    help="pp degree (required for --to interleaved)")
+    ap.add_argument("--vpp", type=int, default=None,
+                    help="virtual stages (required for --to interleaved)")
+    args = ap.parse_args()
+
+    import numpy as np
+    import orbax.checkpoint as ocp
+
+    from scaletorch_tpu.parallel.pipeline_parallel import (
+        _interleaved_layer_order,
+        validate_interleaved_divisibility,
+    )
+
+    src = ocp.CheckpointManager(os.path.abspath(args.ckpt))
+    step = args.step if args.step is not None else src.latest_step()
+    if step is None:
+        raise SystemExit(f"no checkpoints in {args.ckpt}")
+    restored = src.restore(
+        step,
+        args=ocp.args.Composite(
+            params=ocp.args.StandardRestore(),
+            opt_state=ocp.args.StandardRestore(),
+            extra=ocp.args.JsonRestore(),
+        ),
+    )
+    params, opt_state = restored["params"], restored["opt_state"]
+    extra = dict(restored["extra"] or {})
+    cur = extra.get("layer_storage", "model_order")
+
+    import jax
+
+    lead_dims = {
+        leaf.shape[0]
+        for leaf in jax.tree.leaves(params["layers"])
+        if hasattr(leaf, "shape")
+    }
+    if len(lead_dims) != 1:
+        raise SystemExit(
+            f"non-uniform stacked-layer leading dims {sorted(lead_dims)}: "
+            "interleaved conversion needs a uniform stack"
+        )
+    (num_layers,) = lead_dims
+
+    if args.to == "interleaved":
+        if cur != "model_order":
+            raise SystemExit(f"checkpoint is already {cur!r}")
+        if not args.pp or not args.vpp:
+            raise SystemExit("--to interleaved requires --pp and --vpp")
+        pp, vpp = args.pp, args.vpp
+        validate_interleaved_divisibility(num_layers, pp, vpp)
+        idx = np.asarray(_interleaved_layer_order(num_layers, pp, vpp))
+        new_storage = f"interleaved_pp{pp}_vpp{vpp}"
+    else:
+        if not cur.startswith("interleaved_pp"):
+            raise SystemExit(
+                f"checkpoint layer_storage is {cur!r}; nothing to invert")
+        body = cur[len("interleaved_pp"):]
+        pp, vpp = (int(x) for x in body.split("_vpp"))
+        idx = np.argsort(_interleaved_layer_order(num_layers, pp, vpp))
+        new_storage = "model_order"
+
+    params = _permute_layers_subtrees(params, idx, num_layers)
+    opt_state = _permute_layers_subtrees(opt_state, idx, num_layers)
+    extra["layer_storage"] = new_storage
+
+    dst = ocp.CheckpointManager(os.path.abspath(args.out))
+    dst.save(step, args=ocp.args.Composite(
+        params=ocp.args.StandardSave(params),
+        opt_state=ocp.args.StandardSave(opt_state),
+        extra=ocp.args.JsonSave(extra),
+    ))
+    dst.wait_until_finished()
+    print(f"step {step}: {cur} -> {new_storage} "
+          f"(L={num_layers}, pp={pp}, vpp={vpp}) written to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
