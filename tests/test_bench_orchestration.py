@@ -311,6 +311,21 @@ def test_moe_dispatch_ab_error_leg_skips_ratio(fake_bench, capsys,
     assert "qwen3-0.6b_seq2048_bs2" in table  # bulk rows still measured
 
 
+def test_table_mode_appends_dispatch_ab(fake_bench, capsys, monkeypatch):
+    """--table: the dispatch A/B legs run after the single-chip rows and
+    the ratio summary lands in the table artifact."""
+    monkeypatch.setenv("BENCH_TABLE_ROW_BUDGET", "10")
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=52.0,
+               moe_einsum="ok", moe_einsum_step=3.0,
+               moe_index="ok", moe_index_step=2.0)
+    assert bench.run_table() == 0
+    _stdout_line(capsys)  # driver contract: exactly one stdout line
+    table = json.loads(open("bench_table.json").read())
+    assert table["moe_dispatch_ab"]["index_speedup_wallclock"] == 1.5
+    assert len(table) == len(bench.SINGLE_CHIP_ROWS) + 3  # 2 legs + summary
+
+
 def test_stale_child_mode_env_cannot_hijack_children(fake_bench, capsys,
                                                      monkeypatch):
     """An exported BENCH_PREFLIGHT=1 left over from manual debugging must

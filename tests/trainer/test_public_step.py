@@ -78,12 +78,16 @@ def test_resume_across_pp_engines_refuses_scrambled_layers(tmp_path):
 
 
 @pytest.mark.slow
-def test_convert_layer_storage_roundtrips_resume(tmp_path):
+@pytest.mark.parametrize("optimizer", ["adamw", "adafactor"])
+def test_convert_layer_storage_roundtrips_resume(tmp_path, optimizer):
     """tools/convert_layer_storage.py is the documented path across the
     engine boundary: train afab 2 steps + save, convert the checkpoint
     to interleaved order, resume under pp_engine='interleaved' for 2
     more steps — final params (deinterleaved) must match an
-    uninterrupted 4-step afab run on the same stream."""
+    uninterrupted 4-step afab run on the same stream. adafactor covers
+    the optimizer-state corner: (1,) placeholders and layer-reduced
+    factored stats under the mirrored 'layers' subtree must pass through
+    the permutation untouched (code-review r5)."""
     import subprocess
     import sys
 
@@ -97,7 +101,7 @@ def test_convert_layer_storage_roundtrips_resume(tmp_path):
     def cfg(**kw):
         return _cfg(num_hidden_layers=4, pipeline_parallel_size=2,
                     data_parallel_size=4, micro_batch_size=4,
-                    total_train_steps=4, **kw)
+                    total_train_steps=4, optimizer_name=optimizer, **kw)
 
     # ground truth: uninterrupted afab
     t_ref = Trainer(cfg())

@@ -44,13 +44,19 @@ def _permute_layers_subtrees(tree, idx, num_layers):
             out = [walk(v, in_layers) for v in node]
             return type(node)(out)
         if in_layers and hasattr(node, "shape") and node.ndim >= 1:
-            if node.shape[0] != num_layers:
-                raise ValueError(
-                    f"stacked-layer leaf with leading dim {node.shape[0]} != "
-                    f"num_layers {num_layers}: cannot permute a non-uniform "
-                    "stack (interleaved storage requires uniform stacking)"
-                )
-            return node[idx]
+            if node.shape[0] == num_layers:
+                return node[idx]
+            if node.shape[0] == 1:
+                # adafactor stores (1,) placeholders and layer-REDUCED
+                # row/col stats under the mirrored 'layers' subtree
+                # (trainer/factored.py); both are invariant under a layer
+                # permutation — pass through untouched.
+                return node
+            raise ValueError(
+                f"stacked-layer leaf with leading dim {node.shape[0]} != "
+                f"num_layers {num_layers}: cannot permute a non-uniform "
+                "stack (interleaved storage requires uniform stacking)"
+            )
         return node
 
     return walk(tree, False)
@@ -123,6 +129,9 @@ def main() -> None:
                 f"checkpoint layer_storage is {cur!r}; nothing to invert")
         body = cur[len("interleaved_pp"):]
         pp, vpp = (int(x) for x in body.split("_vpp"))
+        # metadata could be hand-edited/mismatched: an L that pp*vpp does
+        # not divide would silently TRUNCATE the permutation below
+        validate_interleaved_divisibility(num_layers, pp, vpp)
         idx = np.argsort(_interleaved_layer_order(num_layers, pp, vpp))
         new_storage = "model_order"
 
