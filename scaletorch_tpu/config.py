@@ -165,8 +165,10 @@ class ParallelArguments:
                           "pp_engine='interleaved' (Megatron "
                           "virtual-pipeline chunks). Each rank owns this "
                           "many non-contiguous layer chunks; the pipeline "
-                          "bubble shrinks by ~this factor. Must be >= 2 "
-                          "with the interleaved engine, 1 otherwise."},
+                          "bubble shrinks by ~this factor. >= 2 with the "
+                          "interleaved engine, or 0 = auto (largest "
+                          "divisor <= 4 of the per-rank layer count); "
+                          "1 otherwise."},
     )
     sequence_parallel: bool = field(
         default=False, metadata={"help": "Megatron-style SP over the tp axis."}
@@ -193,11 +195,13 @@ class ParallelArguments:
                 f"or the reference-compat alias '1f1b', got {self.pp_engine!r}"
             )
         if self.pp_engine == "interleaved":
-            if self.pp_virtual_stages < 2:
+            if self.pp_virtual_stages < 2 and self.pp_virtual_stages != 0:
                 raise ValueError(
-                    "pp_engine='interleaved' needs pp_virtual_stages >= 2 "
-                    f"(got {self.pp_virtual_stages}); with 1 virtual stage "
-                    "per rank the schedule IS afab — use pp_engine='afab'"
+                    "pp_engine='interleaved' needs pp_virtual_stages >= 2, "
+                    "or 0 for auto (largest divisor <= 4 of the per-rank "
+                    f"layer count); got {self.pp_virtual_stages}. With 1 "
+                    "virtual stage per rank the schedule IS afab — use "
+                    "pp_engine='afab'"
                 )
         elif self.pp_virtual_stages != 1:
             raise ValueError(

@@ -17,6 +17,7 @@ from scaletorch_tpu.parallel.pipeline_parallel import (  # noqa: F401
     pipeline_interleaved_loss,
     pipeline_spmd_loss,
     stage_layer_partition,
+    suggest_virtual_stages,
     unpad_stacked_params,
     validate_interleaved_divisibility,
     validate_pp_divisibility,

@@ -197,6 +197,23 @@ def validate_interleaved_divisibility(num_layers: int, pp: int, vpp: int) -> Non
         )
 
 
+def suggest_virtual_stages(num_layers: int, pp: int, max_vpp: int = 4) -> int:
+    """Largest usable vpp in [2, max_vpp] (1 when none divides): the
+    bubble shrinks ~vpp x unconditionally, while the net compiled memory
+    is config-dependent (AOT_PP_INTERLEAVED.json: vpp=2 IMPROVES temp
+    HBM at 0.6b and 30B-A3B but 4B/gc/vpp=3 regresses 0.5 GB — extra
+    tick carries vs smaller per-tick remat set). Beyond ~4 the per-chunk
+    compute gets too thin to hide the ring hop, hence the cap; verify
+    memory per config with tools/aot_memory.py --pp-vpp."""
+    if pp < 2 or num_layers % pp != 0:
+        return 1
+    per_rank = num_layers // pp
+    for v in range(min(max_vpp, per_rank), 1, -1):
+        if per_rank % v == 0:
+            return v
+    return 1
+
+
 def _interleaved_layer_order(num_layers: int, pp: int, vpp: int) -> List[int]:
     """Global layer indices in rank-major interleaved storage order: rank
     r's pp-shard = [chunk 0 | chunk 1 | ...] where chunk c is virtual

@@ -185,6 +185,39 @@ class Trainer:
         cfg.validate_world_size(len(jax.devices()))
         self.mm: MeshManager = setup_mesh_manager(**cfg.mesh_kwargs())
         self.model_cfg = build_model_config(cfg)
+        # Resolved virtual-stage count: cfg.pp_virtual_stages, with the 0
+        # sentinel (auto) resolved into a Trainer ATTRIBUTE — never back
+        # into cfg, which the caller may reuse for another model whose
+        # layer count resolves differently.
+        self._pp_vpp = cfg.pp_virtual_stages
+        if (cfg.pipeline_parallel_size > 1
+                and cfg.pp_engine == "interleaved"
+                and cfg.pp_virtual_stages == 0):
+            from scaletorch_tpu.parallel.pipeline_parallel import (
+                suggest_virtual_stages,
+            )
+
+            num_layers = self.model_cfg.num_hidden_layers
+            pp = cfg.pipeline_parallel_size
+            self._pp_vpp = suggest_virtual_stages(num_layers, pp)
+            if self._pp_vpp < 2:
+                if num_layers % pp:
+                    raise ValueError(
+                        f"pp_engine='interleaved' cannot apply: "
+                        f"num_hidden_layers={num_layers} is not divisible "
+                        f"by pp={pp} (no pp_virtual_stages value can fix "
+                        "this) — use pp_engine='afab', which pads uneven "
+                        "layer counts"
+                    )
+                raise ValueError(
+                    f"pp_virtual_stages=0 (auto) found no virtual-stage "
+                    f"count: per-rank layer count {num_layers // pp} has "
+                    "no divisor in [2, 4] — set pp_virtual_stages "
+                    f"explicitly (any divisor of {num_layers // pp} >= 2) "
+                    "or use pp_engine='afab'"
+                )
+            self.logger.info(
+                f"pp_virtual_stages auto-resolved to {self._pp_vpp}")
         self.attention_backend = resolve_attention_backend(
             cfg.attention_backend, context_parallel=cfg.context_parallel_size > 1
         )
@@ -334,7 +367,7 @@ class Trainer:
                 params_host["layers"],
                 self.model_cfg.num_hidden_layers,
                 cfg.pipeline_parallel_size,
-                cfg.pp_virtual_stages,
+                self._pp_vpp,
             )
 
         # clip-free optimizer: the SPMD step applies TP-correct clipping.
@@ -373,7 +406,7 @@ class Trainer:
             max_grad_norm=cfg.max_grad_norm,
             donate=cfg.donate_params,
             pp_schedule=cfg.pp_engine,
-            pp_vpp=cfg.pp_virtual_stages,
+            pp_vpp=self._pp_vpp,
             cp_layout=cfg.cp_layout,
             param_specs=param_specs,
             model_kwargs=model_kwargs,
@@ -431,7 +464,7 @@ class Trainer:
                 model_family="qwen3_moe" if is_moe else "llama",
                 cp_layout=cfg.cp_layout,
                 pp_schedule=cfg.pp_engine,
-                pp_vpp=cfg.pp_virtual_stages,
+                pp_vpp=self._pp_vpp,
             )
             self._eval_loader = self._build_eval_loader()
 
@@ -626,7 +659,7 @@ class Trainer:
         if (cfg.pipeline_parallel_size > 1
                 and cfg.pp_engine == "interleaved"):
             return (f"interleaved_pp{cfg.pipeline_parallel_size}"
-                    f"_vpp{cfg.pp_virtual_stages}")
+                    f"_vpp{self._pp_vpp}")
         return "model_order"
 
     def save_checkpoint(self) -> None:
@@ -658,7 +691,7 @@ class Trainer:
                 f"checkpoint stores layers in {saved_storage!r} order but "
                 f"this run uses {self._layer_storage()!r} "
                 f"(pp_engine={self.cfg.pp_engine}, "
-                f"pp_virtual_stages={self.cfg.pp_virtual_stages}): resume "
+                f"pp_virtual_stages={self._pp_vpp}): resume "
                 "with the original engine settings, or convert the "
                 "checkpoint offline with tools/convert_layer_storage.py"
             )

@@ -561,6 +561,22 @@ class TestConfigKnobs:
             ParallelArguments(pp_engine="interleaved")
         pa = ParallelArguments(pp_engine="interleaved", pp_virtual_stages=2)
         assert pa.pp_virtual_stages == 2
+        # 0 = auto sentinel, resolved by the Trainer
+        pa = ParallelArguments(pp_engine="interleaved", pp_virtual_stages=0)
+        assert pa.pp_virtual_stages == 0
+
+    def test_suggest_virtual_stages(self):
+        from scaletorch_tpu.parallel.pipeline_parallel import (
+            suggest_virtual_stages,
+        )
+
+        assert suggest_virtual_stages(8, 2) == 4       # per-rank 4
+        assert suggest_virtual_stages(28, 2) == 2      # per-rank 14: 4,3 no; 2 yes
+        assert suggest_virtual_stages(36, 2) == 3      # per-rank 18: 4 no; 3 yes
+        assert suggest_virtual_stages(48, 2) == 4
+        assert suggest_virtual_stages(10, 2) == 1      # per-rank 5: no divisor
+        assert suggest_virtual_stages(8, 3) == 1       # pp doesn't divide L
+        assert suggest_virtual_stages(8, 1) == 1       # no pipeline
 
     def test_vpp_requires_interleaved(self):
         from scaletorch_tpu.config import ParallelArguments

@@ -49,6 +49,28 @@ def test_step_counts_actual_batch_tokens():
 
 
 @pytest.mark.slow
+def test_auto_virtual_stages_resolves_and_trains():
+    """pp_virtual_stages=0: the Trainer picks the largest divisor <= 4 of
+    the per-rank layer count (4 layers / pp2 -> vpp 2) and the resolved
+    value flows into the engine, checkpoint metadata, and a working step."""
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    t = Trainer(_cfg(num_hidden_layers=4, pipeline_parallel_size=2,
+                     data_parallel_size=4, pp_engine="interleaved",
+                     pp_virtual_stages=0))
+    try:
+        assert t._pp_vpp == 2
+        # the caller's cfg keeps the sentinel: reusing it for another
+        # model must re-resolve, not inherit this model's vpp
+        assert t.cfg.pp_virtual_stages == 0
+        assert t._layer_storage() == "interleaved_pp2_vpp2"
+        m = t.step()
+        assert np.isfinite(float(m["loss"]))
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
 def test_resume_across_pp_engines_refuses_scrambled_layers(tmp_path):
     """The interleave permutation preserves shapes, so resuming an afab
     checkpoint under pp_engine='interleaved' (or vice versa) can only be
