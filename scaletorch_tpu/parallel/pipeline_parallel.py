@@ -35,7 +35,7 @@ pp_comms.py:86-286 blocking P2P), re-designed TPU-first:
     cost 2(M+pp-1) ticks — bubble fraction (pp-1)/(M+pp-1), the SAME as
     textbook 1F1B; MPMD-style F/B interleaving would cost M+2(pp-1)
     combined ticks, i.e. strictly more here. 1F1B's remaining advantage
-    is memory, which ``memory_chunked`` provides: measured 1.25x slower
+    is memory, which ``memory_chunked`` provides: measured 1.28x slower
     than afab at pp=4/accum=8 (predicted 1.27x from tick counts) — hence
     the honest name: it is 1F1B's memory bound, NOT a faster schedule.
 
