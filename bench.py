@@ -73,6 +73,16 @@ SINGLE_CHIP_ROWS = {
     "qwen3-0.6b_seq2048_bs2": ("qwen3-0.6b", dict(seq=2048, micro_bs=2), 22.5, 9731),
     HEADLINE: ("qwen3-0.6b", dict(seq=8192, gc=True), 39.0, 9834),
     "qwen3-0.6b_seq16384_bs1_gc": ("qwen3-0.6b", dict(seq=16384, gc=True), 56.0, 9079),
+    # Same reference row, the AOT-planned recipe (AOT_SEQ16K.json
+    # on_chip_plan): bf16 master + save_attn keeps the flash kernel's
+    # (out, lse) so GC backward skips the flash-forward recompute — the
+    # likely MFU winner at this length. Giving the driver BOTH recipes
+    # maximises the odds of landing the 56.0% target in one invocation.
+    "qwen3-0.6b_seq16384_bf16_save_attn": (
+        "qwen3-0.6b",
+        dict(seq=16384, gc=True, remat_policy="save_attn",
+             extra={"param_dtype": "bfloat16"}),
+        56.0, 9079),
     # 1.7B/4B rows store master weights + adam moments in bf16 — exactly
     # what the reference's torch bf16 AdamW stores (tensor.to(bf16) model,
     # exp_avg/exp_avg_sq in param dtype). fp32 master state for 1.7B is
@@ -394,6 +404,9 @@ def run_row(label: str, warmup: int, steps: int) -> dict:
         # diffs show WHAT changed, not just that the number moved.
         **{k: v for k, v in shape.get("extra", {}).items()
            if k in ("param_dtype", "optimizer_name")},
+        **({"remat_policy": shape["remat_policy"]}
+           if shape.get("remat_policy", "nothing_saveable")
+           != "nothing_saveable" else {}),
     }
 
 
@@ -593,12 +606,22 @@ def run_headline() -> int:
         _dump_table(table)
         return not res.timed_out
 
-    # priority order (VERDICT): the seq-16384 row (reference's 56.0% best)
-    # first, then the MoE dispatch wall-clock A/B, then the rest of the
-    # single-chip table.
-    go = _measure("qwen3-0.6b_seq16384_bs1_gc",
-                  dict(extra_env, BENCH_ROW="qwen3-0.6b_seq16384_bs1_gc"),
-                  "BENCH_EXTRA_ROW_BUDGET")
+    # priority order (VERDICT): the seq-16384 rows (reference's 56.0%
+    # best — standard recipe, then the AOT-planned bf16+save_attn
+    # recipe), then the MoE dispatch wall-clock A/B, then the rest of
+    # the single-chip table.
+    # the bf16+save_attn recipe only makes sense on the flash path (its
+    # whole point is keeping the kernel's (out, lse) residuals); when
+    # SDPA won, skip it so the dispatch A/B stays reachable in-budget
+    seq16k_rows = ["qwen3-0.6b_seq16384_bs1_gc"]
+    if pallas_won:
+        seq16k_rows.append("qwen3-0.6b_seq16384_bf16_save_attn")
+    go = True
+    for label in seq16k_rows:
+        go = _measure(label, dict(extra_env, BENCH_ROW=label),
+                      "BENCH_EXTRA_ROW_BUDGET")
+        if not go:
+            break
     if go:
         for mode in ("einsum", "index"):
             go = _measure(f"moe_dispatch_{mode}",
