@@ -271,6 +271,27 @@ def test_extra_rows_stop_after_a_timeout(fake_bench, capsys, monkeypatch):
     assert len(extras) == 1
 
 
+def test_save_attn_recipe_row_gated_on_pallas_win(fake_bench, capsys,
+                                                  monkeypatch):
+    """The bf16+save_attn seq-16384 recipe exists for the flash kernel's
+    saved residuals: it must run when pallas wins and be skipped when
+    SDPA wins (keeping the dispatch A/B reachable in-budget)."""
+    monkeypatch.setenv("BENCH_TOTAL_BUDGET", "100000")
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=52.0)
+    assert bench.run_headline() == 0
+    _stdout_line(capsys)
+    table = json.loads(open("bench_table.json").read())
+    assert "qwen3-0.6b_seq16384_bf16_save_attn" in table
+
+    fake_bench(sdpa_row="ok", sdpa_row_mfu=45.4, preflight="error")
+    assert bench.run_headline() == 0
+    _stdout_line(capsys)
+    table = json.loads(open("bench_table.json").read())
+    assert "qwen3-0.6b_seq16384_bs1_gc" in table
+    assert "qwen3-0.6b_seq16384_bf16_save_attn" not in table
+
+
 def test_moe_dispatch_ab_measured_after_seq16k(fake_bench, capsys,
                                                monkeypatch):
     """Phase 3.5: with budget, the einsum/index wall-clock A/B runs right
