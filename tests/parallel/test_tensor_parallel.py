@@ -71,6 +71,7 @@ class TestParallelLayers:
         )
         np.testing.assert_allclose(f(ids, table), table[ids], atol=1e-6)
 
+    @pytest.mark.slow  # the ignore_index variant + fused-CE tests keep quick coverage
     def test_vocab_parallel_cross_entropy(self):
         mm = MeshManager(tp=8)
         logits = jax.random.normal(jax.random.PRNGKey(6), (2, 8, 64))

@@ -206,6 +206,7 @@ def test_mid_run_hang_budgets_and_classifies_stage(fake_bench, monkeypatch):
     assert "backend_up" in res.error
 
 
+@pytest.mark.slow
 def test_table_mode_short_circuits_after_wedge(fake_bench, capsys, monkeypatch):
     """A wedged row must not burn every later row's budget: the chip is
     held, so remaining rows are recorded as skipped."""
@@ -271,6 +272,7 @@ def test_extra_rows_stop_after_a_timeout(fake_bench, capsys, monkeypatch):
     assert len(extras) == 1
 
 
+@pytest.mark.slow
 def test_save_attn_recipe_row_gated_on_pallas_win(fake_bench, capsys,
                                                   monkeypatch):
     """The bf16+save_attn seq-16384 recipe exists for the flash kernel's
@@ -316,6 +318,7 @@ def test_moe_dispatch_ab_measured_after_seq16k(fake_bench, capsys,
         "moe_dispatch_einsum")
 
 
+@pytest.mark.slow
 def test_moe_dispatch_ab_error_leg_skips_ratio(fake_bench, capsys,
                                                monkeypatch):
     """A failed A/B leg must not fabricate a speedup; the remaining table

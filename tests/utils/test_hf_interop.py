@@ -82,6 +82,7 @@ class TestLoadHF:
         theirs = _hf_logits(model, ids)
         np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow  # TestStreamedLoad covers the sharded-load contract
     def test_load_into_shardings(self, tmp_path):
         from jax.sharding import NamedSharding
         from scaletorch_tpu.parallel.mesh import MeshManager
