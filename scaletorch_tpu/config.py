@@ -82,8 +82,10 @@ class ModelArguments:
     tie_word_embeddings: bool = False
     attention_backend: str = field(
         default="auto",
-        metadata={"help": "auto | flash | ring | sdpa — auto resolves like the "
-                          "reference (CP->ring, FLASH_ATTEN->flash, else sdpa)."},
+        metadata={"help": "auto | flash | flash_jax | ring | sdpa — auto "
+                          "resolves like the reference (CP->ring, "
+                          "FLASH_ATTEN->flash, else sdpa); flash_jax is "
+                          "jax's reference TPU kernel for on-chip A/B."},
     )
     # MoE knobs (qwen3_moe / gpt_moe)
     num_experts: int = 8

@@ -62,3 +62,44 @@ def flash_attention(
 
 
 register_attention_backend("flash", flash_attention)
+
+
+def flash_attention_jax(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """JAX's reference TPU flash kernel as an alternative backend.
+
+    ``jax.experimental.pallas.ops.tpu.flash_attention`` is the
+    public, heavily-tuned Mosaic implementation — registering it as
+    ``flash_jax`` gives the benchmark an on-chip A/B partner for the
+    in-repo kernel (ops/pallas/flash.py), the same role the reference's
+    backend registry plays between its sdpa / flash-attn / npu paths
+    (reference models/attention_utils.py:56-70). It predates GQA index
+    maps, so grouped K/V heads are expanded here (cheap: K/V are
+    S x D x Hkv bf16, ~67 MB at 0.6B/seq8192 — the in-repo kernel's
+    unexpanded reads stay the default).
+
+    Off-TPU (CPU tests, AOT-less sessions) falls back to SDPA like the
+    ``flash`` backend does.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if _pallas_available():
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as _jax_flash,
+        )
+
+        from scaletorch_tpu.models.layers import repeat_kv
+
+        n_rep = q.shape[1] // k.shape[1]
+        return _jax_flash(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                          causal=causal, sm_scale=scale)
+    return sdpa_attention(q, k, v, causal=causal, scale=scale)
+
+
+register_attention_backend("flash_jax", flash_attention_jax)

@@ -119,6 +119,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_sc, m_sc, l_sc,
         lse_ref[0, 0] = (m_sc[:, 0] + jnp.log(l[:, 0]))[None, :]
 
 
+def _semantics(*dims):
+    """Mosaic grid dimension semantics: 'p' = parallel (no cross-iteration
+    carry — megacore-partitionable on 2-core chips), 'a' = arbitrary (the
+    sequential reduction dims that carry scratch accumulators). Declaring
+    them lets Mosaic schedule DMAs/compute across iterations instead of
+    assuming every dim may carry state."""
+    m = {"p": pltpu.PARALLEL, "a": pltpu.ARBITRARY}
+    return pltpu.CompilerParams(
+        dimension_semantics=tuple(m[d] for d in dims))
+
+
 def _flash_forward(q, k, v, causal, scale, bq, bkv, interpret):
     b, hq, sq, d = q.shape
     _, hkv, skv, _ = k.shape
@@ -136,6 +147,7 @@ def _flash_forward(q, k, v, causal, scale, bq, bkv, interpret):
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv),
         grid=grid,
+        compiler_params=_semantics("p", "p", "p", "a"),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
             pl.BlockSpec((1, 1, bkv, d),
@@ -274,6 +286,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, bq, bkv, interpret):
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv),
         grid=(b, hq, nq, nkv),
+        compiler_params=_semantics("p", "p", "p", "a"),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda b_, h, i, j: (b_, h, i, 0)),
             pl.BlockSpec((1, 1, bkv, d),
@@ -298,6 +311,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, bq, bkv, interpret):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bkv=bkv),
         grid=(b, hkv, nkv, n_rep, nq),
+        compiler_params=_semantics("p", "p", "p", "a", "a"),
         in_specs=[
             pl.BlockSpec((1, 1, bq, d),
                          lambda b_, hk, jj, r, i: (b_, hk * n_rep + r,

@@ -52,6 +52,14 @@ def _pick_block(n: int, preferred: int) -> int:
     return max(b, 1)
 
 
+def _semantics(*dims):
+    """'p' = parallel grid dim, 'a' = arbitrary (sequential reduction dim
+    carrying a scratch accumulator) — see ops/pallas/flash.py."""
+    m = {"p": pltpu.PARALLEL, "a": pltpu.ARBITRARY}
+    return pltpu.CompilerParams(
+        dimension_semantics=tuple(m[d] for d in dims))
+
+
 def _kernel(count_ref, x_ref, wg_ref, wu_ref, wd_ref, o_ref, acc_sc,
             *, bc, bi, ni):
     c_t = pl.program_id(2)  # slot tile within the (e, g) block
@@ -196,6 +204,7 @@ def _backward(x, counts, wg, wu, wd, do, bc, bi, interpret):
     dx = pl.pallas_call(
         functools.partial(_dx_kernel, bc=bc, bi=bi, ni=ni),
         grid=(e, g, nc, ni),
+        compiler_params=_semantics("p", "p", "p", "a"),
         in_specs=[
             pl.BlockSpec((1, 1, 1, 1), lambda e_, g_, c_, i_: (e_, g_, 0, 0)),
             pl.BlockSpec((1, 1, bc, h), lambda e_, g_, c_, i_: (e_, g_, c_, 0)),
@@ -214,6 +223,7 @@ def _backward(x, counts, wg, wu, wd, do, bc, bi, interpret):
     dwg, dwu, dwd = pl.pallas_call(
         functools.partial(_dw_kernel, bc=bc, bi=bi, ng=g, nc=nc),
         grid=(e, i_dim // bi, g, nc),
+        compiler_params=_semantics("p", "p", "a", "a"),
         in_specs=[
             pl.BlockSpec((1, 1, 1, 1), lambda e_, i_, g_, c_: (e_, g_, 0, 0)),
             pl.BlockSpec((1, 1, bc, h), lambda e_, i_, g_, c_: (e_, g_, c_, 0)),
@@ -251,6 +261,7 @@ def _forward(x, counts, wg, wu, wd, bc, bi, interpret):
     return pl.pallas_call(
         functools.partial(_kernel, bc=bc, bi=bi, ni=ni),
         grid=grid,
+        compiler_params=_semantics("p", "p", "p", "a"),
         in_specs=[
             pl.BlockSpec((1, 1, 1, 1), lambda e_, g_, c_, i_: (e_, g_, 0, 0)),
             pl.BlockSpec((1, 1, bc, h), lambda e_, g_, c_, i_: (e_, g_, c_, 0)),
