@@ -354,8 +354,147 @@ class CheckpointArguments:
     checkpoint_dir: Optional[str] = None
     save_frequency: int = 0
     resume_from_checkpoint: bool = False
+    resume: str = field(
+        default="off",
+        metadata={"help": "off | auto | must — 'auto' resumes from the "
+                          "latest checkpoint in checkpoint_dir when one "
+                          "exists and trains from scratch otherwise (what "
+                          "a restarted preempted job wants); 'must' fails "
+                          "fast when no checkpoint is found; 'off' never "
+                          "resumes (resume_from_checkpoint=true is kept as "
+                          "a compat alias for 'auto')."},
+    )
     async_checkpointing: bool = True
     keep_n_checkpoints: int = 3
+    checkpoint_retries: int = field(
+        default=3,
+        metadata={"help": "Retries (with exponential backoff + jitter) "
+                          "around each checkpoint save/restore attempt "
+                          "before giving up on it."},
+    )
+    checkpoint_retry_base_delay: float = field(
+        default=0.5,
+        metadata={"help": "First retry delay in seconds; doubles per "
+                          "attempt, capped at 16x."},
+    )
+
+    def __post_init__(self) -> None:
+        if self.resume not in ("off", "auto", "must"):
+            raise ValueError(
+                f"resume must be 'off', 'auto' or 'must', got {self.resume!r}"
+            )
+        if self.resume == "must" and not self.checkpoint_dir:
+            # 'must' exists to fail FAST — silently training from scratch
+            # because the restart spec forgot checkpoint_dir defeats it
+            raise ValueError(
+                "--resume must requires --checkpoint_dir"
+            )
+        if self.checkpoint_retries < 0:
+            raise ValueError(
+                f"checkpoint_retries must be >= 0, got {self.checkpoint_retries}"
+            )
+
+
+@dataclass
+class ResilienceArguments:
+    """Fault-tolerance knobs (scaletorch_tpu/resilience.py): divergence
+    sentinel policy, preemption handling, and fault-injection hooks."""
+
+    nonfinite_guard: bool = field(
+        default=True,
+        metadata={"help": "Reject optimizer updates with non-finite loss/"
+                          "grad-norm inside the jitted train step (params "
+                          "and optimizer state keep their previous values "
+                          "for that step)."},
+    )
+    divergence_policy: str = field(
+        default="skip",
+        metadata={"help": "skip | rollback | abort — what the host-side "
+                          "sentinel does on an anomalous (non-finite or "
+                          "spiking) loss. 'rollback' restores the last "
+                          "good checkpoint and fast-forwards the data "
+                          "stream past the bad region."},
+    )
+    loss_spike_factor: float = field(
+        default=0.0,
+        metadata={"help": "Treat loss > factor * EMA(loss) as an anomaly "
+                          "(0 = only non-finite losses are anomalous)."},
+    )
+    loss_ema_beta: float = field(
+        default=0.98, metadata={"help": "EMA decay for the loss baseline."}
+    )
+    max_consecutive_anomalies: int = field(
+        default=3,
+        metadata={"help": "Abort after this many consecutive anomalous "
+                          "steps under any policy (0 = never)."},
+    )
+    max_rollbacks: int = field(
+        default=3,
+        metadata={"help": "Abort after this many sentinel-triggered "
+                          "rollbacks (0 = unlimited)."},
+    )
+    sentinel_frequency: int = field(
+        default=-1,
+        metadata={"help": "Sample the loss on the host every N steps for "
+                          "the sentinel (forces a device sync on sampled "
+                          "steps). -1 (default) follows log_frequency — "
+                          "those steps already pay the sync for logging, "
+                          "so the sentinel adds none; 0 disables the host "
+                          "sentinel (the in-step nonfinite_guard still "
+                          "applies); 1 samples every step for the "
+                          "tightest detection latency."},
+    )
+    handle_preemption: bool = field(
+        default=True,
+        metadata={"help": "Install SIGTERM/SIGINT handlers during train() "
+                          "that request an emergency checkpoint at the "
+                          "next step boundary and exit cleanly."},
+    )
+    # Fault injection (testing/drills; env vars SCALETORCH_TPU_FT_* override)
+    ft_nan_at_step: int = field(
+        default=0,
+        metadata={"help": "Inject a NaN loss after optimizer step k "
+                          "(0 = off; fires once)."},
+    )
+    ft_fail_saves: int = field(
+        default=0,
+        metadata={"help": "Fail the first n checkpoint save attempts with "
+                          "a retriable I/O error (0 = off)."},
+    )
+    ft_sigterm_at_step: int = field(
+        default=0,
+        metadata={"help": "Deliver SIGTERM to this process after optimizer "
+                          "step k (0 = off; fires once)."},
+    )
+
+    def __post_init__(self) -> None:
+        if self.divergence_policy not in ("skip", "rollback", "abort"):
+            raise ValueError(
+                "divergence_policy must be 'skip', 'rollback' or 'abort', "
+                f"got {self.divergence_policy!r}"
+            )
+        if self.loss_spike_factor != 0 and self.loss_spike_factor <= 1.0:
+            # a factor in (0, 1] flags virtually every healthy step
+            # (loss ~= EMA) as a spike and aborts within a few steps
+            raise ValueError(
+                "loss_spike_factor must be 0 (off) or > 1 (spike when "
+                f"loss > factor * EMA), got {self.loss_spike_factor}"
+            )
+        if not 0.0 <= self.loss_ema_beta < 1.0:
+            raise ValueError(
+                f"loss_ema_beta must be in [0, 1), got {self.loss_ema_beta}"
+            )
+        if self.sentinel_frequency < -1:
+            raise ValueError(
+                "sentinel_frequency must be -1 (follow log_frequency), 0 "
+                f"(off) or a positive period, got {self.sentinel_frequency}"
+            )
+        for name in ("max_consecutive_anomalies",
+                     "max_rollbacks", "ft_nan_at_step", "ft_fail_saves",
+                     "ft_sigterm_at_step"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}")
 
 
 @dataclass
@@ -389,6 +528,7 @@ class ScaleTorchTPUArguments(
     OptimizerArguments,
     TrainingArguments,
     CheckpointArguments,
+    ResilienceArguments,
     LoggingArguments,
 ):
     """All training arguments, composed (reference config.py:393-403)."""
@@ -396,6 +536,12 @@ class ScaleTorchTPUArguments(
     def __post_init__(self) -> None:
         ParallelArguments.__post_init__(self)
         DistributedArguments.__post_init__(self)
+        CheckpointArguments.__post_init__(self)
+        ResilienceArguments.__post_init__(self)
+        # resume_from_checkpoint predates the tri-state knob: keep it as a
+        # compat alias for --resume auto (never weaken an explicit 'must').
+        if self.resume_from_checkpoint and self.resume == "off":
+            self.resume = "auto"
         if self.sequence_length % self.context_parallel_size != 0:
             raise ValueError(
                 f"sequence_length {self.sequence_length} not divisible by "

@@ -77,3 +77,10 @@ register_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "0", _as_bool)
 # host-side heuristic).
 register_env("SCALETORCH_TPU_FLASH_BLOCK_Q", "512", int)
 register_env("SCALETORCH_TPU_FLASH_BLOCK_KV", "512", int)
+
+# Fault-injection hooks (resilience.FaultInjector): 0 = off. Env overrides
+# the ft_* config fields so a running job can be drilled without a config
+# edit (e.g. SCALETORCH_TPU_FT_SIGTERM_STEP=100 simulates preemption).
+register_env("SCALETORCH_TPU_FT_NAN_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_FAIL_SAVES", "0", int)
+register_env("SCALETORCH_TPU_FT_SIGTERM_STEP", "0", int)

@@ -80,15 +80,25 @@ def flash_attention_jax(
     in-repo kernel (ops/pallas/flash.py), the same role the reference's
     backend registry plays between its sdpa / flash-attn / npu paths
     (reference models/attention_utils.py:56-70). It predates GQA index
-    maps, so grouped K/V heads are expanded here (cheap: K/V are
-    S x D x Hkv bf16, ~67 MB at 0.6B/seq8192 — the in-repo kernel's
-    unexpanded reads stay the default).
+    maps, so grouped K/V heads (layout ``[B, Hkv, S, D]``) are expanded
+    to ``[B, Hq, S, D]`` here — post-expansion K/V memory and DMA
+    traffic scale with Hq, not Hkv (n_rep x larger: ~0.5 GB at
+    0.6B/seq8192 with Hq=14/Hkv=2 bf16). Acceptable for an A/B probe;
+    the in-repo kernel's unexpanded Hkv reads stay the default.
 
     Off-TPU (CPU tests, AOT-less sessions) falls back to SDPA like the
     ``flash`` backend does.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if q.shape[1] % k.shape[1]:
+        # mirror the explicit guard the in-repo Pallas entry points raise
+        # (pallas/flash.py) — a silent floor-division here would surface
+        # as an obscure head-count mismatch inside jax's kernel
+        raise ValueError(
+            f"flash_attention_jax: query heads {q.shape[1]} must be a "
+            f"multiple of key/value heads {k.shape[1]}"
+        )
     if _pallas_available():
         from jax.experimental.pallas.ops.tpu.flash_attention import (
             flash_attention as _jax_flash,

@@ -85,6 +85,7 @@ def make_fsdp_train_step(
     gradient_checkpointing: bool = False,
     donate: bool = True,
     axis: str = AXIS,
+    nonfinite_guard: bool = True,
 ) -> Callable:
     """Jitted FSDP step: (sharded_params, sharded_opt_state, batch) ->
     (params, opt_state, metrics). Batch: [accum, rows, seq] with rows
@@ -106,6 +107,7 @@ def make_fsdp_train_step(
         donate=donate,
         mesh=mesh,
         data_spec=P(None, axis, None),
+        nonfinite_guard=nonfinite_guard,
     )
 
 

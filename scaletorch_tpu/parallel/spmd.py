@@ -349,6 +349,7 @@ def make_spmd_train_step(
     custom_pipeline_loss: Optional[Callable] = None,
     custom_pipeline_has_aux: bool = False,
     pp_vpp: int = 1,
+    nonfinite_guard: bool = True,
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -371,6 +372,14 @@ def make_spmd_train_step(
     ``pp_schedule`` selects 'afab' or 'memory_chunked' (programmatic alias
     '1f1b' — reference pp_engine, config.py:155-173) — the accum dim of
     the batch is the microbatch dim.
+
+    ``nonfinite_guard``: reject the update (params and optimizer state
+    keep their previous values) when loss or global grad norm is
+    NaN/Inf, reporting ``update_skipped`` in the metrics. Both scalars
+    are already all-reduced here, so every shard takes the same branch —
+    the rejection is mesh-consistent by construction (the resilience
+    layer's in-step half; host-side policy lives in
+    scaletorch_tpu/resilience.py).
     """
     use_pp = mm.pp > 1
     if (use_pp and custom_pipeline_loss is None
@@ -648,9 +657,19 @@ def make_spmd_train_step(
         # param_dtype) need bf16 moments — fp32 grads would silently promote
         # mu/nu to fp32 on the first update and break buffer donation.
         grads = jax.tree.map(lambda g, w: g.astype(w.dtype), grads, p)
-        updates, opt_state = tx.update(grads, opt_state, p)
-        p = optax.apply_updates(p, updates)
-        return p, opt_state, {"loss": loss, "grad_norm": grad_norm, **extras}
+        metrics = {"loss": loss, "grad_norm": grad_norm, **extras}
+        if nonfinite_guard:
+            from scaletorch_tpu.trainer.train_step import guarded_update
+
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            p, opt_state, skipped = guarded_update(
+                tx, p, opt_state, grads, ok
+            )
+            metrics["update_skipped"] = skipped
+        else:
+            updates, opt_state = tx.update(grads, opt_state, p)
+            p = optax.apply_updates(p, updates)
+        return p, opt_state, metrics
 
     sharded = jax.shard_map(
         step,

@@ -25,6 +25,15 @@ from scaletorch_tpu.utils.device import device_memory_stats, get_theoretical_flo
 from scaletorch_tpu.utils.logger import get_logger
 from scaletorch_tpu.utils.misc import get_mfu, to_readable_format
 
+# Cumulative resilience counters (DivergenceSentinel.counters / the
+# in-step update_skipped flag) recognised in ``extras`` — forwarded into
+# the SystemMonitor ring buffer and surfaced on the console line when
+# nonzero.
+ANOMALY_COUNTER_KEYS = (
+    "anomalies", "nonfinite_losses", "loss_spikes", "rollbacks",
+    "update_skipped",
+)
+
 
 @dataclass
 class MetricsLogger:
@@ -114,8 +123,14 @@ class MetricsLogger:
         if self._monitor is not None:
             # reuse the stats fetched above (no second allocator poll) and
             # skip the monitor's device_(peak_)mem_gb aliases of the
-            # memory_gb/peak_memory_gb fields already written
-            sys_rec = self._monitor.sample(step, device_stats=mem)
+            # memory_gb/peak_memory_gb fields already written; resilience
+            # counters ride into the monitor's ring buffer so a post-mortem
+            # tail shows when anomalies clustered
+            sys_rec = self._monitor.sample(
+                step, device_stats=mem,
+                counters={k: record[k] for k in ANOMALY_COUNTER_KEYS
+                          if k in record},
+            )
             record.update(
                 (k, v) for k, v in sys_rec.items()
                 if k not in ("time", "step", "device_mem_gb",
@@ -140,6 +155,10 @@ class MetricsLogger:
                 parts.append(f"drop {record['moe_dropped_fraction']:.2%}")
             if "moe_load_cv" in record:
                 parts.append(f"load_cv {record['moe_load_cv']:.2f}")
+            if record.get("update_skipped"):
+                parts.append("UPDATE-SKIPPED")
+            if record.get("anomalies"):
+                parts.append(f"anomalies {int(record['anomalies'])}")
             if "memory_gb" in record:
                 parts.append(f"mem {record['memory_gb']:.1f}GB")
             get_logger().info(" | ".join(parts))

@@ -90,6 +90,38 @@ def accumulate_gradients(
     return loss_sum * scale, grads
 
 
+def guarded_update(
+    optimizer: optax.GradientTransformation,
+    params: Any,
+    opt_state: Any,
+    grads: Any,
+    ok: jax.Array,
+) -> Tuple[Any, Any, jax.Array]:
+    """Apply the optimizer update only when ``ok`` (a traced scalar bool)
+    holds; otherwise params and the optimizer's FLOAT state (moments,
+    factored statistics) keep their previous values so NaN/Inf never
+    pollutes them. Integer state leaves — the step counters driving
+    lr/weight-decay schedules — advance regardless: a skipped batch still
+    consumes a global step, and freezing the count (what
+    ``optax.apply_if_finite`` does) would silently desync every schedule
+    from the trainer's ``global_step`` by one step per rejection. Returns
+    ``(params, opt_state, update_skipped)`` where ``update_skipped`` is
+    1.0 on a rejected step.
+
+    Shared by the declarative step below and the SPMD shard_map step
+    (parallel/spmd.py) so both reject non-finite updates identically.
+    """
+    updates, new_opt_state = optimizer.update(grads, opt_state, params)
+    new_params = optax.apply_updates(params, updates)
+    select = lambda n, o: jnp.where(ok, n, o)  # noqa: E731
+    params = jax.tree.map(select, new_params, params)
+    opt_state = jax.tree.map(
+        lambda n, o: n if jnp.issubdtype(n.dtype, jnp.integer) else select(n, o),
+        new_opt_state, opt_state,
+    )
+    return params, opt_state, 1.0 - ok.astype(jnp.float32)
+
+
 def make_train_step(
     forward: Callable,
     cfg,
@@ -100,6 +132,7 @@ def make_train_step(
     donate: bool = True,
     mesh=None,
     data_spec=None,
+    nonfinite_guard: bool = True,
 ) -> Callable:
     """Build the jitted step: (params, opt_state, batch) ->
     (params, opt_state, metrics).
@@ -107,6 +140,12 @@ def make_train_step(
     ``mesh``/``data_spec`` optionally pin GSPMD shardings: batch leaves get
     ``data_spec`` (e.g. P(None, 'dp', None)), params/opt-state shardings are
     taken from their current placement.
+
+    ``nonfinite_guard`` (the divergence sentinel's in-step half,
+    resilience layer): a step whose loss or global grad norm is NaN/Inf
+    leaves params and optimizer state untouched and reports
+    ``update_skipped=1`` in the metrics, so one poisoned batch cannot
+    destroy the run between checkpoints.
     """
     loss_fn = make_loss_fn(
         forward,
@@ -121,9 +160,16 @@ def make_train_step(
         # Param-dtype grads into the optimizer so bf16 master params keep
         # bf16 moments (same contract as the SPMD step, parallel/spmd.py).
         grads = jax.tree.map(lambda g, w: g.astype(w.dtype), grads, params)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
         metrics = {"loss": loss, "grad_norm": grad_norm}
+        if nonfinite_guard:
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            params, opt_state, skipped = guarded_update(
+                optimizer, params, opt_state, grads, ok
+            )
+            metrics["update_skipped"] = skipped
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
         return params, opt_state, metrics
 
     donate_argnums = (0, 1) if donate else ()

@@ -146,6 +146,30 @@ def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg):
     )
 
 
+def validate_layer_storage(
+    saved: str,
+    current: str,
+    *,
+    pp_engine: str,
+    pp_virtual_stages: int,
+) -> None:
+    """Refuse a resume whose stacked-layer STORAGE order differs from the
+    checkpoint's. The interleaved engine permutes the layer axis with
+    unchanged shapes, so no shape check can catch a cross-engine resume —
+    only this metadata can. Checkpoints predating the field trained in
+    model order, so the 'model_order' default makes them refuse an
+    interleaved resume."""
+    if saved != current:
+        raise ValueError(
+            f"checkpoint stores layers in {saved!r} order but "
+            f"this run uses {current!r} "
+            f"(pp_engine={pp_engine}, "
+            f"pp_virtual_stages={pp_virtual_stages}): resume "
+            "with the original engine settings, or convert the "
+            "checkpoint offline with tools/convert_layer_storage.py"
+        )
+
+
 class Trainer:
     """End-to-end training driver (reference train.py main + loop)."""
 
@@ -412,6 +436,7 @@ class Trainer:
             model_kwargs=model_kwargs,
             head_weight_fn=head_weight_fn,
             model_family="qwen3_moe" if is_moe else "llama",
+            nonfinite_guard=cfg.nonfinite_guard,
         )
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)
@@ -445,6 +470,26 @@ class Trainer:
         )
         self.global_step = 0
         self.tokens_seen = 0
+        self.preempted = False
+        self.emergency_checkpoint_saved = False
+        # Stream-position skew: normally the loader position IS
+        # global_step, but a sentinel rollback fast-forwards the stream
+        # PAST the anomalous region while global_step moves back to the
+        # checkpoint — the delta must persist through later checkpoints
+        # or a restart would replay the very batch that diverged.
+        # _saved_loader_position tracks what the newest on-disk
+        # checkpoint stores, so the emergency-save shortcut can tell a
+        # truly-covered boundary from a stale pre-rollback save.
+        self._loader_skew = 0
+        self._saved_loader_position = None
+        self._wandb_logged_step = 0
+        # Host-side resilience: divergence sentinel (policy over anomalous
+        # losses), fault injector (config/env drills), preemption handler
+        # (installed for the duration of train()). The device-side half is
+        # the nonfinite_guard traced into step_fn above.
+        from scaletorch_tpu.resilience import ResilienceManager
+
+        self.resilience = ResilienceManager.from_config(cfg)
         self._train_iter = None
         self._ckpt_mgr = None
         self._eval_fn = None
@@ -492,6 +537,9 @@ class Trainer:
                 self.cfg.checkpoint_dir,
                 keep_n=self.cfg.keep_n_checkpoints,
                 async_save=self.cfg.async_checkpointing,
+                retries=self.cfg.checkpoint_retries,
+                retry_base_delay=self.cfg.checkpoint_retry_base_delay,
+                fault_injector=self.resilience.injector,
             )
         return self._ckpt_mgr
 
@@ -594,38 +642,98 @@ class Trainer:
         return m
 
     def train(self, num_steps: Optional[int] = None) -> Dict[str, Any]:
-        num_steps = num_steps or self.cfg.total_train_steps
+        """Run the training loop.
+
+        ``num_steps`` runs exactly that many MORE optimizer steps (the
+        benchmark/example contract); the default runs to the absolute
+        ``cfg.total_train_steps`` target, so a run resumed from step k
+        continues to the same final step as an uninterrupted one instead
+        of appending a whole fresh budget.
+
+        Fault tolerance per step boundary: preemption requests (SIGTERM/
+        SIGINT while ``handle_preemption``) trigger an emergency
+        checkpoint and a clean early return with ``self.preempted`` set;
+        anomalous losses go through the divergence sentinel's configured
+        policy (skip / rollback-to-last-good / abort).
+        """
+        if num_steps is None:
+            target_step = max(self.cfg.total_train_steps, self.global_step)
+        else:
+            target_step = self.global_step + num_steps
         last = {}
-        for _ in range(num_steps):
-            m = self.step()
-            last = self.metrics.log_step(
-                self.global_step,
-                loss=m["loss"],
-                # optax evaluates schedule(count) BEFORE incrementing, so the
-                # update just applied used count = global_step - 1.
-                lr=float(self.schedule(self.global_step - 1)),
-                grad_norm=m["grad_norm"],
-                extras={k: v for k, v in m.items()
-                        if k not in ("loss", "grad_norm")},
-            )
-            if (
-                self.cfg.eval_frequency
-                and self.global_step % self.cfg.eval_frequency == 0
-            ):
-                val = self.evaluate()
-                if val is not None:
-                    self.logger.info(
-                        f"step {self.global_step:>6} | val_loss {val:.4f}"
-                    )
-                    last = {**last, "val_loss": val}
-            if last and self._wandb is not None:
-                self._wandb.log(last, step=self.global_step)
-            if (
-                self.cfg.save_frequency
-                and self.cfg.checkpoint_dir
-                and self.global_step % self.cfg.save_frequency == 0
-            ):
-                self.save_checkpoint()
+        self.preempted = False
+        if self.cfg.handle_preemption:
+            if jax.process_count() == 1:
+                self.resilience.install_preemption_handler()
+            else:
+                # A one-sided emergency save would enter orbax's
+                # cross-process collective without its peers (hosts'
+                # SIGTERMs land at different step boundaries) and wedge
+                # the pod. Until the stop flag is agreed across hosts at
+                # the boundary, multi-process runs rely on the external
+                # scheduler + periodic saves (same carve-out as the
+                # checkpoint retry path, utils/checkpoint.py).
+                self.logger.warning(
+                    "handle_preemption: in-process SIGTERM handling is "
+                    "single-host only; multi-process runs resume from "
+                    "the last periodic checkpoint instead"
+                )
+        try:
+            while self.global_step < target_step:
+                if self.resilience.stop_requested:
+                    self._emergency_checkpoint()
+                    self.preempted = True
+                    break
+                m = self.step()
+                anomaly_step = self.global_step
+                m, action = self.resilience.after_step(
+                    anomaly_step, m,
+                    rollback=lambda: self._rollback_to_last_good(anomaly_step),
+                )
+                if action == "rollback":
+                    # global_step has moved back to the restored
+                    # checkpoint; the anomalous step's metrics would be
+                    # logged against the wrong step — drop them.
+                    continue
+                last = self.metrics.log_step(
+                    self.global_step,
+                    loss=m["loss"],
+                    # optax evaluates schedule(count) BEFORE incrementing, so
+                    # the update just applied used count = global_step - 1.
+                    lr=float(self.schedule(self.global_step - 1)),
+                    grad_norm=m["grad_norm"],
+                    extras={
+                        **{k: v for k, v in m.items()
+                           if k not in ("loss", "grad_norm")},
+                        **self.resilience.counters(),
+                    },
+                )
+                if (
+                    self.cfg.eval_frequency
+                    and self.global_step % self.cfg.eval_frequency == 0
+                ):
+                    val = self.evaluate()
+                    if val is not None:
+                        self.logger.info(
+                            f"step {self.global_step:>6} | val_loss {val:.4f}"
+                        )
+                        last = {**last, "val_loss": val}
+                if (last and self._wandb is not None
+                        and self.global_step > self._wandb_logged_step):
+                    # after a rollback the step counter regresses; wandb
+                    # rejects non-monotonic steps and would silently drop
+                    # the whole recovery region — resume logging once the
+                    # counter passes its high-water mark
+                    self._wandb.log(last, step=self.global_step)
+                    self._wandb_logged_step = self.global_step
+                if (
+                    self.cfg.save_frequency
+                    and self.cfg.checkpoint_dir
+                    and self.global_step % self.cfg.save_frequency == 0
+                ):
+                    self.save_checkpoint()
+        finally:
+            self.resilience.uninstall_preemption_handler()
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()  # drain any in-flight async save
         if self.cfg.performance_log_dir:
@@ -662,48 +770,155 @@ class Trainer:
                     f"_vpp{self._pp_vpp}")
         return "model_order"
 
-    def save_checkpoint(self) -> None:
-        self.checkpoint_manager.save(
+    def save_checkpoint(self) -> bool:
+        position = self.global_step + self._loader_skew
+        saved = self.checkpoint_manager.save(
             step=self.global_step,
             params=self.params,
             opt_state=self.opt_state,
             extra={"tokens_seen": self.tokens_seen,
+                   "loader_position": position,
                    "layer_storage": self._layer_storage()},
         )
+        if saved:
+            self._saved_loader_position = position
+        return saved
 
-    def load_checkpoint(self) -> None:
+    def load_checkpoint(self, required: bool = False) -> bool:
+        """Restore the newest readable checkpoint; returns whether one was
+        restored. ``required`` (--resume must) raises instead of training
+        from scratch when nothing restores."""
         restored = self.checkpoint_manager.load_latest(
             params=self.params, opt_state=self.opt_state
         )
         if restored is None:
+            if required:
+                raise FileNotFoundError(
+                    f"--resume must: no restorable checkpoint in "
+                    f"{self.cfg.checkpoint_dir}"
+                )
             self.logger.warning(
                 f"resume requested but no checkpoint found in "
                 f"{self.cfg.checkpoint_dir}; training from scratch"
             )
-            return
-        # note: uneven-PP padding IS shape-checked by orbax's template
-        # restore; only the shape-preserving interleave permutation needs
-        # this metadata. Checkpoints predating the field trained in model
-        # order, so the default makes them refuse an interleaved resume.
-        saved_storage = restored["extra"].get("layer_storage", "model_order")
-        if saved_storage != self._layer_storage():
-            raise ValueError(
-                f"checkpoint stores layers in {saved_storage!r} order but "
-                f"this run uses {self._layer_storage()!r} "
-                f"(pp_engine={self.cfg.pp_engine}, "
-                f"pp_virtual_stages={self._pp_vpp}): resume "
-                "with the original engine settings, or convert the "
-                "checkpoint offline with tools/convert_layer_storage.py"
-            )
+            return False
+        validate_layer_storage(
+            restored["extra"].get("layer_storage", "model_order"),
+            self._layer_storage(),
+            pp_engine=self.cfg.pp_engine,
+            pp_virtual_stages=self._pp_vpp,
+        )
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.global_step = restored["step"]
         self.tokens_seen = restored["extra"].get("tokens_seen", 0)
         # Fast-forward the data stream so resumed training continues the
-        # dataset walk instead of replaying it (sampler epoch parity). A
-        # live step() iterator predates set_state and would keep yielding
-        # from the old position — drop it so the next step() re-iterates.
+        # dataset walk instead of replaying it (sampler epoch parity).
+        # loader_position may be AHEAD of global_step when a sentinel
+        # rollback skipped an anomalous region before this save —
+        # restoring the skew keeps the bad batch retired across restarts.
+        # A live step() iterator predates set_state and would keep
+        # yielding from the old position — drop it so the next step()
+        # re-iterates.
+        position = restored["extra"].get("loader_position", self.global_step)
+        self._loader_skew = position - self.global_step
+        self._saved_loader_position = position
         if hasattr(self.loader, "set_state"):
-            self.loader.set_state(self.global_step)
+            self.loader.set_state(position)
         self._train_iter = None
         self.logger.info(f"resumed from step {self.global_step}")
+        return True
+
+    def _rollback_to_last_good(self, anomaly_step: int) -> bool:
+        """Divergence-sentinel rollback: restore the last good checkpoint
+        and fast-forward the data stream PAST the anomalous region, so
+        the retrained steps see fresh data instead of replaying the batch
+        that diverged. Returns False (caller downgrades to skip) when no
+        checkpoint is restorable."""
+        if not self.cfg.checkpoint_dir:
+            return False
+        # Drain any in-flight async save FIRST: a just-dispatched save
+        # (not yet visible to latest_step) would otherwise finalize after
+        # the restore and resurface as a stale newest checkpoint carrying
+        # the pre-rollback loader position.
+        self.checkpoint_manager.wait()
+        if self.checkpoint_manager.latest_step() is None:
+            return False
+        self.logger.warning(
+            f"divergence at step {anomaly_step}: rolling back to the last "
+            "good checkpoint and fast-forwarding the data stream"
+        )
+        # The anomalous batch's TRUE stream position accounts for skew
+        # accumulated by earlier rollbacks — capture it before
+        # load_checkpoint overwrites the skew from the checkpoint.
+        bad_position = anomaly_step + self._loader_skew
+        if not self.load_checkpoint():
+            return False
+        # fast-forward PAST the bad region and remember the skew so later
+        # checkpoints persist the retired batches (neither a restart nor
+        # a second rollback may replay a batch that diverged)
+        self._loader_skew = bad_position - self.global_step
+        if hasattr(self.loader, "set_state"):
+            self.loader.set_state(bad_position)
+            self._train_iter = None
+        return True
+
+    def _emergency_checkpoint(self) -> bool:
+        """Preemption-safe shutdown: synchronously persist the current
+        state at the step boundary (reference graceful-abort role,
+        train.py:257-268 — here with a real checkpoint). Returns whether
+        this step's state is actually on disk (also recorded as
+        ``self.emergency_checkpoint_saved`` for the entry point's exit
+        message)."""
+        sig = (self.resilience.preemption.signum
+               if self.resilience.preemption is not None else None)
+        if not self.cfg.checkpoint_dir:
+            self.logger.warning(
+                f"preemption requested (signal {sig}) but no "
+                "checkpoint_dir is configured: exiting without a "
+                "checkpoint"
+            )
+            self.emergency_checkpoint_saved = False
+            return False
+        if (self.checkpoint_manager.latest_step() == self.global_step
+                and self._saved_loader_position
+                == self.global_step + self._loader_skew):
+            # the save cadence already covered this boundary — same step
+            # AND same loader position (a rollback can change the skew
+            # after the step was saved, making the on-disk checkpoint
+            # stale even at a matching step number). The save may still
+            # be an in-flight async write: drain it and RE-CHECK the
+            # directory before trusting it (wait() swallows async
+            # failures by degrading to sync).
+            self.checkpoint_manager.wait()
+            if self.checkpoint_manager.latest_step() == self.global_step:
+                self.logger.warning(
+                    f"preemption requested (signal {sig}): step "
+                    f"{self.global_step} is already checkpointed; exiting"
+                )
+                self.emergency_checkpoint_saved = True
+                return True
+            # the in-flight save failed — fall through to a fresh save
+        if self.checkpoint_manager.latest_step() == self.global_step:
+            # same step number but STALE content (e.g. the loader skew
+            # changed after a rollback): orbax silently skips same-step
+            # saves, so the stale one must be deleted to be replaced
+            try:
+                self.checkpoint_manager.delete(self.global_step)
+            except Exception as exc:
+                self.logger.error(
+                    f"could not replace stale checkpoint at step "
+                    f"{self.global_step}: {exc!r}"
+                )
+        self.logger.warning(
+            f"preemption requested (signal {sig}): writing emergency "
+            f"checkpoint at step {self.global_step}"
+        )
+        saved = self.save_checkpoint()
+        self.checkpoint_manager.wait()
+        # wait() may have degraded async->sync after a pool failure; the
+        # directory listing is the ground truth for "is my step on disk"
+        saved = saved and (
+            self.checkpoint_manager.latest_step() == self.global_step)
+        self.emergency_checkpoint_saved = saved
+        return saved

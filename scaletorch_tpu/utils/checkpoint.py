@@ -7,6 +7,14 @@ host writes exactly its owned shards of the global arrays (the dp0/cp0
 de-duplication falls out of sharding), restore re-shards to the current
 mesh, and async saving overlaps with training.
 
+I/O hardening (resilience layer): every save/restore attempt runs under
+exponential backoff with jitter (resilience.retry_with_backoff) because on
+long runs flaky distributed storage is the steady state; a dying async
+pool degrades to synchronous saving instead of killing the run; a
+corrupted/partial latest checkpoint falls back to the previous step on
+restore. A retriable save failure NEVER propagates — losing one
+checkpoint is recoverable, losing the run is not.
+
 HF-safetensors interop (load-time materialization with TP/PP/EP slicing,
 reference checkpoint.py:23-464) lives in utils/hf_interop.py.
 """
@@ -14,28 +22,65 @@ reference checkpoint.py:23-464) lives in utils/hf_interop.py.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from scaletorch_tpu.resilience import retry_with_backoff
+from scaletorch_tpu.utils.logger import get_logger
+
 
 class CheckpointManager:
-    """Step-indexed orbax checkpoints with retention + resume."""
+    """Step-indexed orbax checkpoints with retention + resume + retries."""
 
     def __init__(
         self,
         directory: str,
         keep_n: int = 3,
         async_save: bool = False,
+        retries: int = 3,
+        retry_base_delay: float = 0.5,
+        fault_injector: Optional[Any] = None,
     ) -> None:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self._keep_n = keep_n
+        self._async = async_save
+        self.retries = retries
+        self.retry_base_delay = retry_base_delay
+        # orbax save/restore are CROSS-PROCESS collectives on multi-host
+        # runs: a host-local retry or async->sync fallback would re-enter
+        # the collective without its peers and wedge or desync the run.
+        # Until the retry decision is itself coordinated, multi-process
+        # runs keep the pre-hardening semantics (one attempt, exceptions
+        # propagate symmetrically on every host).
+        self._single_process = jax.process_count() == 1
+        # resilience.FaultInjector (or None): lets tests/drills fail the
+        # first n save attempts with a retriable error.
+        self._injector = fault_injector
+        self._mgr = self._make_mgr()
+
+    def _make_mgr(self) -> ocp.CheckpointManager:
         options = ocp.CheckpointManagerOptions(
-            max_to_keep=keep_n,
-            enable_async_checkpointing=async_save,
+            max_to_keep=self._keep_n,
+            enable_async_checkpointing=self._async,
         )
-        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+        return ocp.CheckpointManager(self.directory, options=options)
+
+    def _fallback_to_sync(self) -> None:
+        """Replace a (possibly wedged) async manager with a synchronous
+        one — slower saves beat a dead run."""
+        get_logger().warning(
+            "async checkpointing degraded: falling back to synchronous "
+            "saves for the rest of the run"
+        )
+        try:
+            self._mgr.close()
+        except Exception:
+            pass  # the pool may already be dead; that's why we're here
+        self._async = False
+        self._mgr = self._make_mgr()
 
     def save(
         self,
@@ -43,28 +88,89 @@ class CheckpointManager:
         params: Any,
         opt_state: Any,
         extra: Optional[Dict[str, Any]] = None,
-    ) -> None:
-        composite = ocp.args.Composite(
-            params=ocp.args.StandardSave(params),
-            opt_state=ocp.args.StandardSave(opt_state),
-            extra=ocp.args.JsonSave(extra or {}),
-        )
-        self._mgr.save(step, args=composite)
+    ) -> bool:
+        """Save with retries; returns False (never raises) when every
+        attempt failed, or when orbax skipped the save because the step
+        already exists (delete() it first to replace) — a lost
+        checkpoint is recoverable, a dead run is not."""
+
+        def attempt() -> bool:
+            if self._injector is not None and self._injector.take_save_failure():
+                raise OSError(
+                    f"injected checkpoint save failure (step {step})"
+                )
+            return bool(self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    params=ocp.args.StandardSave(params),
+                    opt_state=ocp.args.StandardSave(opt_state),
+                    extra=ocp.args.JsonSave(extra or {}),
+                ),
+            ))
+
+        if not self._single_process:
+            return attempt()  # collective: no host-local retry (see __init__)
+        try:
+            # like the restore path: only transient I/O earns backoff
+            # sleeps — a deterministic bug (serialization TypeError,
+            # structure mismatch) fails fast to the handling below
+            return retry_with_backoff(
+                attempt,
+                retries=self.retries,
+                base_delay=self.retry_base_delay,
+                retriable=(OSError,),
+                describe=f"checkpoint save (step {step})",
+            )
+        except Exception as exc:
+            if self._async:
+                # The async pool may be what's broken — degrade to sync
+                # and give the same attempt budget one more go.
+                self._fallback_to_sync()
+                try:
+                    return retry_with_backoff(
+                        attempt,
+                        retries=self.retries,
+                        base_delay=self.retry_base_delay,
+                        retriable=(OSError,),
+                        describe=f"sync checkpoint save (step {step})",
+                    )
+                except Exception as exc2:
+                    exc = exc2
+            get_logger().error(
+                f"checkpoint save at step {step} failed after retries: "
+                f"{exc!r}; training continues without this checkpoint"
+            )
+            return False
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        """Drain in-flight async writes; an async failure surfaces here —
+        degrade to synchronous saving instead of crashing the run
+        (single-process only; multi-host degradation must stay symmetric
+        across hosts, see __init__)."""
+        if not self._single_process:
+            self._mgr.wait_until_finished()
+            return
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as exc:
+            get_logger().error(
+                f"async checkpoint write failed: {exc!r}"
+            )
+            self._fallback_to_sync()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
 
-    def load_latest(
-        self, params: Any, opt_state: Any
-    ) -> Optional[Dict[str, Any]]:
-        """Restore the newest checkpoint onto the shardings/dtypes of the
-        given templates; None if the directory has no checkpoints."""
-        step = self.latest_step()
-        if step is None:
-            return None
+    def delete(self, step: int) -> None:
+        """Remove a step (e.g. a stale same-step checkpoint that must be
+        replaced — orbax silently skips saves of an existing step)."""
+        self._mgr.delete(step)
+
+    def all_steps(self) -> List[int]:
+        return sorted(self._mgr.all_steps())
+
+    def _restore_step(self, step: int, params: Any, opt_state: Any
+                      ) -> Dict[str, Any]:
         restored = self._mgr.restore(
             step,
             args=ocp.args.Composite(
@@ -79,6 +185,64 @@ class CheckpointManager:
             "extra": restored["extra"],
             "step": step,
         }
+
+    def load_latest(
+        self, params: Any, opt_state: Any
+    ) -> Optional[Dict[str, Any]]:
+        """Restore the newest readable checkpoint onto the shardings/dtypes
+        of the given templates; a corrupted/partial step falls back to the
+        previous one. None if no checkpoint restores.
+
+        Multi-process runs restore the latest step with one collective
+        attempt and propagate failures (a per-host retry or per-host
+        fallback choice could leave hosts on DIFFERENT steps)."""
+        steps = sorted(self.all_steps(), reverse=True)
+        if not self._single_process:
+            if not steps:
+                return None
+            return self._restore_step(steps[0], params, opt_state)
+        unreadable = []
+        for step in steps:
+            try:
+                out = retry_with_backoff(
+                    lambda: self._restore_step(step, params, opt_state),
+                    retries=self.retries,
+                    base_delay=self.retry_base_delay,
+                    # only transient I/O is worth the backoff on restore;
+                    # deterministic corruption (parse/shape errors) should
+                    # fall straight back to the previous step instead of
+                    # burning retries+1 sleeps per bad checkpoint
+                    retriable=(OSError,),
+                    describe=f"checkpoint restore (step {step})",
+                )
+            except Exception as exc:
+                get_logger().warning(
+                    f"checkpoint at step {step} failed to restore "
+                    f"({exc!r}); falling back to the previous checkpoint"
+                )
+                unreadable.append(step)
+                continue
+            # Retire the unreadable newer steps: while registered they
+            # stay orbax's "latest", and its monotonic should_save would
+            # silently reject EVERY save at a step <= that latest — the
+            # whole retrain window after this fallback would go
+            # unprotected, and a later crash would resume from the stale
+            # unreadable step's older sibling with a stale loader
+            # position.
+            for bad in unreadable:
+                try:
+                    self._mgr.delete(bad)
+                    get_logger().warning(
+                        f"deleted unreadable checkpoint at step {bad}"
+                    )
+                except Exception as exc:
+                    get_logger().error(
+                        f"could not delete unreadable checkpoint at step "
+                        f"{bad}: {exc!r}; saves below step {bad} may be "
+                        "silently skipped"
+                    )
+            return out
+        return None
 
     def close(self) -> None:
         self._mgr.close()

@@ -105,12 +105,17 @@ class SystemMonitor:
         self.records: Deque[Dict[str, Any]] = deque(maxlen=max_records)
 
     def sample(self, step: Optional[int] = None,
-               device_stats: Optional[Dict[str, float]] = None
+               device_stats: Optional[Dict[str, float]] = None,
+               counters: Optional[Dict[str, float]] = None
                ) -> Dict[str, Any]:
         """One telemetry record. ``device_stats``: pass an already-fetched
         ``device_memory_stats()`` dict to avoid a second allocator poll
         (the metrics logger polls it for its own fields each logged
-        step)."""
+        step). ``counters``: cumulative training-health counters (the
+        resilience layer's anomalies / updates-skipped / rollbacks) — in
+        the ring buffer they put a timeline next to the host/device
+        telemetry, so a wedged or diverged run's tail shows WHEN the
+        anomalies clustered relative to memory/load pressure."""
         psutil = self._psutil
         vm = psutil.virtual_memory()
         record: Dict[str, Any] = {
@@ -146,6 +151,8 @@ class SystemMonitor:
                     0.0, 1.0 - largest / free_bytes
                 )
         record.update(read_accelerator_environment())
+        if counters:
+            record.update(counters)
         self.records.append(record)
         return record
 

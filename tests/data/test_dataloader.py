@@ -61,6 +61,26 @@ class TestMicroBatchDataLoader:
                 next(it_res)["input_ids"], seen[k]["input_ids"]
             )
 
+    def test_set_state_on_exact_epoch_boundary(self):
+        """Checkpoint-resume edge case: resuming at exactly K * steps_per_
+        epoch must land on the NEXT epoch's reshuffled order at offset 0,
+        matching the uninterrupted stream batch-for-batch."""
+        tokens = make_tokens(64)
+        spe = MicroBatchDataLoader(tokens, 2, 1, seed=3).steps_per_epoch()
+
+        ref = MicroBatchDataLoader(tokens, 2, 1, seed=3)
+        it_ref = iter(ref)
+        seen = [next(it_ref) for _ in range(2 * spe + 3)]
+
+        resumed = MicroBatchDataLoader(tokens, 2, 1, seed=3)
+        resumed.set_state(2 * spe)  # exactly two full epochs consumed
+        assert resumed.epoch == 2 and resumed._step_offset == 0
+        it_res = iter(resumed)
+        for k in range(2 * spe, 2 * spe + 3):
+            np.testing.assert_array_equal(
+                next(it_res)["input_ids"], seen[k]["input_ids"]
+            )
+
     def test_bad_shape_raises(self):
         with pytest.raises(ValueError, match="seq_len"):
             MicroBatchDataLoader(np.zeros(5, dtype=np.int32), 1, 1)

@@ -95,3 +95,14 @@ def test_uneven_block_fallback():
     )
     ref = sdpa_attention(q, k, v, causal=True)
     assert jnp.max(jnp.abs(out - ref)) < 1e-5
+
+
+def test_flash_jax_rejects_nondivisible_gqa_heads():
+    """flash_attention_jax mirrors the in-repo entry points' explicit
+    guard: hq % hkv != 0 must raise up front instead of floor-dividing
+    into an obscure head-count mismatch inside jax's kernel."""
+    from scaletorch_tpu.ops.flash_attention import flash_attention_jax
+
+    q, k, v = _qkv(hq=4, hkv=3, s=64, d=32)
+    with pytest.raises(ValueError, match="multiple of key/value heads"):
+        flash_attention_jax(q, k, v)

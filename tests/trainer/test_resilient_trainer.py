@@ -1,0 +1,171 @@
+"""Fault tolerance through the REAL 5D SPMD Trainer (slow tier).
+
+The quick-tier harness (tests/test_resilience.py) proves the resilience
+protocol on a mesh-free step; these goldens prove the same inject ->
+recover contracts through the production path: shard_map step with the
+in-jit non-finite guard, orbax checkpoints, loader fast-forward.
+"""
+
+import numpy as np
+import pytest
+
+from scaletorch_tpu.config import ScaleTorchTPUArguments
+
+
+def _cfg(**kw):
+    defaults = dict(
+        model_type="llama", hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        vocab_size=64, sequence_length=16, max_position_embeddings=32,
+        data_parallel_size=8, micro_batch_size=1,
+        gradient_accumulation_steps=2, synthetic_data=True,
+        total_train_steps=6, dtype="float32", donate_params=False,
+        log_frequency=100, async_checkpointing=False,
+        checkpoint_retry_base_delay=0.01, sentinel_frequency=1,
+    )
+    defaults.update(kw)
+    return ScaleTorchTPUArguments(**defaults)
+
+
+def _tokens(n=64, seq=16, vocab=64):
+    return np.random.default_rng(5).integers(
+        0, vocab, size=(n, seq + 1)).astype(np.int32)
+
+
+def _use_file_loader(t, seed=11):
+    """Swap the synthetic stream for a deterministic, resumable
+    MicroBatchDataLoader (set_state support) — same pattern as the
+    uneven-PP resume tests feed explicit batches."""
+    from scaletorch_tpu.data.dataloader import MicroBatchDataLoader
+
+    t.loader = MicroBatchDataLoader(
+        _tokens(), micro_batch_size=t.cfg.micro_batch_size,
+        gradient_accumulation_steps=t.cfg.gradient_accumulation_steps,
+        data_parallel_size=t.cfg.data_parallel_size, seed=seed,
+    )
+    t._train_iter = None
+
+
+@pytest.mark.slow
+def test_spmd_nonfinite_guard_rejects_update():
+    """NaN-poisoned params -> NaN loss inside the shard_map step -> the
+    update is rejected in-jit: every param/opt leaf bit-identical,
+    update_skipped reported."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    t = Trainer(_cfg())
+    try:
+        poisoned = dict(t.params)
+        poisoned["final_norm"] = jax.tree.map(
+            lambda x: (x * jnp.nan).astype(x.dtype), t.params["final_norm"])
+        t.params = poisoned
+        before = jax.device_get(t.params)
+        opt_before = jax.device_get(t.opt_state)
+        m = t.step()
+        assert float(m["update_skipped"]) == 1.0
+        after = jax.device_get(t.params)
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        # float state (moments) frozen; integer schedule counts advance
+        # so lr schedules stay aligned with global_step
+        for a, b in zip(jax.tree.leaves(opt_before),
+                        jax.tree.leaves(jax.device_get(t.opt_state))):
+            if np.issubdtype(np.asarray(b).dtype, np.integer):
+                np.testing.assert_array_equal(np.asarray(a) + 1, b)
+            else:
+                np.testing.assert_array_equal(a, b)
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_injected_nan_skip_policy_trains_to_target(tmp_path):
+    import jax
+
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    t = Trainer(_cfg(checkpoint_dir=str(tmp_path), save_frequency=2,
+                     ft_nan_at_step=3, divergence_policy="skip"))
+    try:
+        t.train()
+        assert t.global_step == 6
+        assert t.resilience.counters()["nonfinite_losses"] == 1.0
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(jax.device_get(t.params)))
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_injected_nan_rollback_restores_checkpoint(tmp_path):
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    t = Trainer(_cfg(checkpoint_dir=str(tmp_path), save_frequency=2,
+                     ft_nan_at_step=3, divergence_policy="rollback"))
+    try:
+        _use_file_loader(t)
+        t.train()
+        assert t.global_step == 6
+        assert t.resilience.counters()["rollbacks"] == 1.0
+    finally:
+        t.close()
+
+
+@pytest.mark.slow
+def test_sigterm_emergency_checkpoint_resume_auto_matches(tmp_path):
+    """Simulated preemption after step 3 -> emergency checkpoint -> a
+    restarted Trainer with --resume auto semantics reaches the same
+    final params as an uninterrupted run."""
+    import jax
+
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    t_ref = Trainer(_cfg())
+    try:
+        _use_file_loader(t_ref)
+        t_ref.train()
+        ref = jax.device_get(t_ref.params)
+    finally:
+        t_ref.close()
+
+    t1 = Trainer(_cfg(checkpoint_dir=str(tmp_path),
+                      ft_sigterm_at_step=3))
+    try:
+        _use_file_loader(t1)
+        t1.train()
+        assert t1.preempted and t1.global_step == 3
+        assert t1.checkpoint_manager.latest_step() == 3
+    finally:
+        t1.close()
+
+    t2 = Trainer(_cfg(checkpoint_dir=str(tmp_path)))
+    try:
+        _use_file_loader(t2)
+        assert t2.load_checkpoint()
+        assert t2.global_step == 3
+        t2.train()  # absolute target: continues to total_train_steps
+        assert t2.global_step == 6
+        final = jax.device_get(t2.params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, atol=2e-5),
+            ref, final,
+        )
+    finally:
+        t2.close()
+
+
+@pytest.mark.slow
+def test_save_retries_complete_run_without_data_loss(tmp_path):
+    from scaletorch_tpu.trainer.trainer import Trainer
+
+    t = Trainer(_cfg(checkpoint_dir=str(tmp_path), save_frequency=2,
+                     ft_fail_saves=2, checkpoint_retries=3))
+    try:
+        t.train()
+        assert t.global_step == 6
+        assert t.checkpoint_manager.all_steps() == [2, 4, 6]
+    finally:
+        t.close()

@@ -83,8 +83,9 @@ class TestTrainStep:
         tx, _ = create_optimizer(args)
         step = make_train_step(forward, CFG, tx, donate=False)
         _, _, m = step(params, tx.init(params), make_batch(2, 1))
-        assert set(m) == {"loss", "grad_norm"}
+        assert set(m) == {"loss", "grad_norm", "update_skipped"}
         assert float(m["grad_norm"]) > 0
+        assert float(m["update_skipped"]) == 0.0
 
     def test_grad_clipping_bounds_update(self, params):
         """With max_grad_norm tiny, the applied update must be bounded."""

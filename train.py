@@ -28,10 +28,30 @@ def main(argv=None) -> int:
 
     cfg = parse_args(argv)
     trainer = Trainer(cfg)
-    if cfg.resume_from_checkpoint and cfg.checkpoint_dir:
-        trainer.load_checkpoint()
+    # --resume auto: a restarted (e.g. preempted-and-rescheduled) job picks
+    # up from the newest readable checkpoint and trains to the SAME
+    # total_train_steps target; with no checkpoint yet it starts from
+    # scratch. --resume must fails fast instead of silently restarting.
+    if cfg.resume != "off" and cfg.checkpoint_dir:
+        trainer.load_checkpoint(required=cfg.resume == "must")
     try:
         last = trainer.train()
+        if trainer.preempted:
+            # exit cleanly either way so the scheduler sees a graceful
+            # shutdown, but be truthful about what survived
+            if trainer.emergency_checkpoint_saved:
+                get_logger().warning(
+                    f"preempted at step {trainer.global_step}; emergency "
+                    "checkpoint saved — restart with --resume auto to "
+                    "continue"
+                )
+            else:
+                get_logger().error(
+                    f"preempted at step {trainer.global_step} and NO "
+                    "emergency checkpoint could be written — a restart "
+                    "resumes from the last periodic save (or scratch)"
+                )
+            return 0
         # final save BEFORE close() so the async dispatch is drained by
         # close()'s wait — otherwise the process could exit mid-write
         if cfg.checkpoint_dir and cfg.save_frequency:
