@@ -48,6 +48,25 @@ class DataArguments:
         metadata={"help": "Cap the synthetic stream's sampled token ids "
                           "below the model vocab (default: model vocab)."},
     )
+    data_read_retries: int = field(
+        default=2,
+        metadata={"help": "Retries (exponential backoff) around each "
+                          "step-batch read before the region is "
+                          "skipped-and-logged (storage-backed token "
+                          "arrays can be transiently unreadable)."},
+    )
+    data_retry_base_delay: float = field(
+        default=0.05,
+        metadata={"help": "First batch-read retry delay in seconds; "
+                          "doubles per attempt."},
+    )
+    data_max_skipped_batches: int = field(
+        default=16,
+        metadata={"help": "Abort when more than this many step batches "
+                          "stay unreadable after retries (a broken — not "
+                          "flaky — data source must not be silently "
+                          "consumed as skips). 0 = unlimited."},
+    )
 
 
 @dataclass
@@ -377,6 +396,16 @@ class CheckpointArguments:
         metadata={"help": "First retry delay in seconds; doubles per "
                           "attempt, capped at 16x."},
     )
+    checkpoint_verify: bool = field(
+        default=False,
+        metadata={"help": "After each successful save, read back the "
+                          "checkpoint's metadata/tree structure and "
+                          "compare against the in-memory spec; a "
+                          "mismatch retires the step immediately (via "
+                          "the unreadable-step retirement path) instead "
+                          "of being discovered at restore time. Opt-in: "
+                          "it drains async saves before verifying."},
+    )
 
     def __post_init__(self) -> None:
         if self.resume not in ("off", "auto", "must"):
@@ -448,7 +477,41 @@ class ResilienceArguments:
         default=True,
         metadata={"help": "Install SIGTERM/SIGINT handlers during train() "
                           "that request an emergency checkpoint at the "
-                          "next step boundary and exit cleanly."},
+                          "next step boundary and exit cleanly. On "
+                          "multi-process runs the stop flag is "
+                          "all-gathered (--ft_coordinate) so any one "
+                          "host's preemption triggers a collective "
+                          "emergency save on every host."},
+    )
+    ft_coordinate: bool = field(
+        default=True,
+        metadata={"help": "Coordinate resilience control decisions "
+                          "across hosts on multi-process runs: host 0 "
+                          "forms each decision (sentinel action, stop "
+                          "request, checkpoint retry/fallback) from the "
+                          "all-gathered per-host observations and "
+                          "broadcasts it, so every host acts in "
+                          "lockstep. Costs one small object gather + "
+                          "broadcast per optimizer step. Env override: "
+                          "SCALETORCH_TPU_FT_COORDINATE."},
+    )
+    ft_hang_timeout: float = field(
+        default=0.0,
+        metadata={"help": "Hang-watchdog timeout in seconds (0 = off): "
+                          "if no train-loop progress (data fetch, step "
+                          "dispatch, checkpoint) lands within this "
+                          "window, dump all thread stacks + the monitor "
+                          "ring buffer to a crash report and exit with "
+                          "code 43 so the launcher restarts the job "
+                          "instead of hanging on a dead collective. Env "
+                          "override: SCALETORCH_TPU_FT_HANG_TIMEOUT."},
+    )
+    crash_report_dir: str = field(
+        default="results",
+        metadata={"help": "Directory for crash_report_step<N>.json "
+                          "post-mortems written on sentinel aborts, "
+                          "rollback-budget exhaustion and watchdog "
+                          "fires."},
     )
     # Fault injection (testing/drills; env vars SCALETORCH_TPU_FT_* override)
     ft_nan_at_step: int = field(
@@ -465,6 +528,34 @@ class ResilienceArguments:
         default=0,
         metadata={"help": "Deliver SIGTERM to this process after optimizer "
                           "step k (0 = off; fires once)."},
+    )
+    ft_sigterm_host: int = field(
+        default=-1,
+        metadata={"help": "Restrict ft_sigterm_at_step to one process "
+                          "index (-1 = every host) — the multi-host "
+                          "drill where exactly one worker is preempted "
+                          "and the fleet must still stop together. Env "
+                          "override: SCALETORCH_TPU_FT_SIGTERM_HOST."},
+    )
+    ft_hang_at_step: int = field(
+        default=0,
+        metadata={"help": "Stall the step boundary once after optimizer "
+                          "step k (0 = off), simulating a dead "
+                          "collective for the hang watchdog. Env "
+                          "override: SCALETORCH_TPU_FT_HANG_STEP."},
+    )
+    ft_hang_seconds: float = field(
+        default=120.0,
+        metadata={"help": "Duration of the injected ft_hang_at_step "
+                          "stall."},
+    )
+    ft_bad_batch_at_step: int = field(
+        default=0,
+        metadata={"help": "Make every read of data-stream position k "
+                          "raise a retriable I/O error (0 = off) — "
+                          "corrupt-shard injection for the loader's "
+                          "retry + skip-and-log path. Env override: "
+                          "SCALETORCH_TPU_FT_BAD_BATCH_STEP."},
     )
 
     def __post_init__(self) -> None:
@@ -491,10 +582,25 @@ class ResilienceArguments:
             )
         for name in ("max_consecutive_anomalies",
                      "max_rollbacks", "ft_nan_at_step", "ft_fail_saves",
-                     "ft_sigterm_at_step"):
+                     "ft_sigterm_at_step", "ft_hang_at_step",
+                     "ft_bad_batch_at_step"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"{name} must be >= 0, got {getattr(self, name)}")
+        if self.ft_hang_timeout < 0:
+            raise ValueError(
+                f"ft_hang_timeout must be >= 0 (0 disables the "
+                f"watchdog), got {self.ft_hang_timeout}"
+            )
+        if self.ft_hang_seconds <= 0:
+            raise ValueError(
+                f"ft_hang_seconds must be > 0, got {self.ft_hang_seconds}"
+            )
+        if self.ft_sigterm_host < -1:
+            raise ValueError(
+                f"ft_sigterm_host must be -1 (any host) or a process "
+                f"index, got {self.ft_sigterm_host}"
+            )
 
 
 @dataclass
@@ -538,6 +644,10 @@ class ScaleTorchTPUArguments(
         DistributedArguments.__post_init__(self)
         CheckpointArguments.__post_init__(self)
         ResilienceArguments.__post_init__(self)
+        for name in ("data_read_retries", "data_max_skipped_batches"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"{name} must be >= 0, got {getattr(self, name)}")
         # resume_from_checkpoint predates the tri-state knob: keep it as a
         # compat alias for --resume auto (never weaken an explicit 'must').
         if self.resume_from_checkpoint and self.resume == "off":

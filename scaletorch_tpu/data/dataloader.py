@@ -20,15 +20,30 @@ trainer.) Position ids stay absolute and global, as CP requires
 
 from __future__ import annotations
 
-from typing import Dict, Iterator, Optional
+from typing import Any, Dict, Iterator, Optional
 
 import numpy as np
+
+from scaletorch_tpu.utils.logger import get_logger
 
 Batch = Dict[str, np.ndarray]
 
 
 class MicroBatchDataLoader:
-    """Yields per-optimizer-step batches from a [N, seq+1] token array."""
+    """Yields per-optimizer-step batches from a [N, seq+1] token array.
+
+    Fault tolerance (resilience layer): each step-batch read runs under
+    ``retry_with_backoff`` (``read_retries`` / ``retry_base_delay``) so a
+    transiently-flaky storage-backed token array (np.memmap over network
+    storage) does not kill the run; a read that stays unreadable —
+    deterministic shard corruption — is skipped-and-logged, bounded by
+    ``max_skipped_batches``. The loader tracks its absolute stream
+    ``position`` (advanced BEFORE each yield, and across skipped
+    regions), which the trainer persists as ``loader_position`` in every
+    checkpoint — so a crash between fetch and step never double-counts a
+    batch, and a restart walks the identical stream with the same
+    batches retired.
+    """
 
     def __init__(
         self,
@@ -38,6 +53,10 @@ class MicroBatchDataLoader:
         data_parallel_size: int = 1,
         seed: int = 42,
         shuffle: bool = True,
+        read_retries: int = 2,
+        retry_base_delay: float = 0.05,
+        max_skipped_batches: int = 16,
+        fault_injector: Optional[Any] = None,
     ) -> None:
         if tokens.ndim != 2:
             raise ValueError(f"tokens must be [N, seq_len+1], got {tokens.shape}")
@@ -60,6 +79,12 @@ class MicroBatchDataLoader:
             )
         self.epoch = 0
         self._step_offset = 0  # intra-epoch resume position
+        self.position = 0      # absolute stream positions consumed
+        self.read_retries = read_retries
+        self.retry_base_delay = retry_base_delay
+        self.max_skipped_batches = max_skipped_batches
+        self.skipped_positions: list[int] = []
+        self._injector = fault_injector
 
     @property
     def tokens_per_step(self) -> int:
@@ -79,22 +104,80 @@ class MicroBatchDataLoader:
         """Fast-forward to just after ``steps_consumed`` optimizer steps —
         checkpoint-resume parity with the reference's sampler epoch bump +
         restored step counters (reference train.py:195-218). Index-only:
-        no data is touched."""
+        no data is touched. Live iterators predate the new state — drop
+        and re-create them (the trainer does)."""
         spe = self.steps_per_epoch()
         self.epoch = steps_consumed // spe
         self._step_offset = steps_consumed % spe
+        self.position = steps_consumed
+
+    def _read_step(self, order: np.ndarray, i: int) -> Optional[Batch]:
+        """One step-batch read under retry-with-backoff; None when the
+        region stayed unreadable and was skipped-and-logged."""
+        from scaletorch_tpu.resilience import retry_with_backoff
+
+        position = self.position
+
+        def read() -> Batch:
+            if self._injector is not None \
+                    and self._injector.take_bad_read(position):
+                raise OSError(
+                    f"injected corrupt batch read at stream position "
+                    f"{position}"
+                )
+            idx = order[i * self.samples_per_step
+                        : (i + 1) * self.samples_per_step]
+            return self._collate(self.tokens[idx])  # [samples, seq+1]
+
+        try:
+            return retry_with_backoff(
+                read,
+                retries=self.read_retries,
+                base_delay=self.retry_base_delay,
+                retriable=(OSError,),
+                describe=f"batch read (stream position {position})",
+            )
+        except OSError as exc:
+            self.skipped_positions.append(position)
+            if (self.max_skipped_batches > 0
+                    and len(self.skipped_positions)
+                    > self.max_skipped_batches):
+                raise RuntimeError(
+                    f"{len(self.skipped_positions)} unreadable step "
+                    f"batches exceed max_skipped_batches="
+                    f"{self.max_skipped_batches} — the data source is "
+                    "broken, not flaky"
+                ) from exc
+            get_logger().error(
+                f"batch read at stream position {position} unreadable "
+                f"after {self.read_retries + 1} attempts ({exc!r}): "
+                "skipping the region (it stays retired on restart via "
+                "loader_position)"
+            )
+            return None
 
     def __iter__(self) -> Iterator[Batch]:
-        """Infinite iterator over optimizer-step batches, cycling epochs."""
+        """Infinite iterator over optimizer-step batches, cycling epochs.
+
+        Bookkeeping advances BEFORE each yield: ``position`` /
+        ``_step_offset`` already count a batch when the caller receives
+        it, so an exception between fetch and optimizer step — or a
+        skip-and-log on an unreadable region — never double-counts a
+        batch when the stream is re-iterated or resumed."""
         while True:
             order = self._epoch_order()
-            start = self._step_offset
-            self._step_offset = 0
-            for i in range(start, self.steps_per_epoch()):
-                idx = order[i * self.samples_per_step : (i + 1) * self.samples_per_step]
-                chunk = self.tokens[idx]  # [samples, seq+1]
-                yield self._collate(chunk)
+            spe = self.steps_per_epoch()
+            while self._step_offset < spe:
+                i = self._step_offset
+                batch = self._read_step(order, i)
+                # advance-before-yield (and before the skip `continue`)
+                self._step_offset = i + 1
+                self.position += 1
+                if batch is None:
+                    continue  # unreadable region skipped; stream moves on
+                yield batch
             self.epoch += 1
+            self._step_offset = 0
 
     def _collate(self, chunk: np.ndarray) -> Batch:
         a, g, s = self.grad_accum, self.global_batch_size, self.seq_len

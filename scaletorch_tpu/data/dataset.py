@@ -68,6 +68,8 @@ class DatasetProcessor:
         tokenize_strategy: str = "concat_chunk",
         text_key: str = "text",
         num_proc: int = 4,
+        load_retries: int = 2,
+        load_retry_base_delay: float = 1.0,
     ) -> None:
         if isinstance(tokenizer_name_or_path, str):
             from transformers import AutoTokenizer
@@ -80,15 +82,33 @@ class DatasetProcessor:
         self.strategy = get_tokenize_strategy(tokenize_strategy)
         self.text_key = text_key
         self.num_proc = num_proc
+        self.load_retries = load_retries
+        self.load_retry_base_delay = load_retry_base_delay
 
     def load(self, dataset_name: str, split: str = "train"):
         """Local json/jsonl path, local dir, or hub name
-        (reference pretrain_dataset.py:13-107)."""
+        (reference pretrain_dataset.py:13-107). Hub/network fetches run
+        under retry-with-backoff — on a multi-host pod every worker pulls
+        the dataset at startup, and one transient hub hiccup must not
+        kill the whole fleet's launch."""
         import datasets as hf_datasets
 
-        if os.path.isfile(dataset_name) and dataset_name.endswith((".json", ".jsonl")):
-            return hf_datasets.load_dataset("json", data_files=dataset_name)[split]
-        return hf_datasets.load_dataset(dataset_name, split=split)
+        from scaletorch_tpu.resilience import retry_with_backoff
+
+        def _load():
+            if os.path.isfile(dataset_name) \
+                    and dataset_name.endswith((".json", ".jsonl")):
+                return hf_datasets.load_dataset(
+                    "json", data_files=dataset_name)[split]
+            return hf_datasets.load_dataset(dataset_name, split=split)
+
+        return retry_with_backoff(
+            _load,
+            retries=self.load_retries,
+            base_delay=self.load_retry_base_delay,
+            retriable=(OSError, ConnectionError),
+            describe=f"dataset load ({dataset_name})",
+        )
 
     def tokenize(self, dataset):
         """Map the strategy over the dataset, dropping raw columns."""

@@ -35,6 +35,17 @@ def env_snapshot() -> dict[str, Any]:
     return {k: get_env(k) for k in sorted(_REGISTRY)}
 
 
+def env_override(name: str, fallback: Any) -> Any:
+    """Registered env var when PRESENT — including an explicit 0/empty, so
+    a restarted job can CANCEL a config-armed knob without a config edit —
+    else the caller's fallback (usually the config field). The single home
+    of the present-wins contract shared by every SCALETORCH_TPU_FT_*
+    consumer (resilience.FaultInjector, resilience_distributed)."""
+    if os.environ.get(name) is not None:
+        return get_env(name)
+    return fallback
+
+
 # ---- process-rank discovery (shared by dist.py and logger.py) ---------------
 # The first three are the explicit 'env' launcher contract
 # (dist.init_distributed); the scheduler-set tail is only a pre-backend-init
@@ -84,3 +95,12 @@ register_env("SCALETORCH_TPU_FLASH_BLOCK_KV", "512", int)
 register_env("SCALETORCH_TPU_FT_NAN_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_FAIL_SAVES", "0", int)
 register_env("SCALETORCH_TPU_FT_SIGTERM_STEP", "0", int)
+# Multi-host resilience (resilience_distributed.py): restrict the SIGTERM
+# drill to one host, inject a step-boundary stall, corrupt one data-stream
+# read, tune the hang watchdog, and toggle cross-host decision
+# coordination without a config edit.
+register_env("SCALETORCH_TPU_FT_SIGTERM_HOST", "-1", int)
+register_env("SCALETORCH_TPU_FT_HANG_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_BAD_BATCH_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_HANG_TIMEOUT", "0", float)
+register_env("SCALETORCH_TPU_FT_COORDINATE", "1", _as_bool)

@@ -296,31 +296,50 @@ class FaultInjector:
     * ``fail_saves`` — make the first n checkpoint-save attempts raise
       (consumed by CheckpointManager), proving the retry/backoff path.
     * ``sigterm_at_step`` — deliver a real SIGTERM to this process after
-      optimizer step k, simulating preemption.
+      optimizer step k, simulating preemption. ``sigterm_host`` restricts
+      delivery to one process index (the multi-host drill: exactly ONE
+      worker is preempted and the fleet must still stop together).
+    * ``hang_at_step`` / ``hang_seconds`` — stall the step boundary once
+      after step k, simulating a dead collective for the hang watchdog.
+    * ``bad_batch_at_step`` — every read of stream position k raises a
+      retriable I/O error (a corrupt shard: deterministic, so retries
+      fail and the loader's skip-and-log path must retire the region).
 
     Env overrides (taking precedence over config so a running job can be
     probed without a config edit): ``SCALETORCH_TPU_FT_NAN_STEP``,
-    ``SCALETORCH_TPU_FT_FAIL_SAVES``, ``SCALETORCH_TPU_FT_SIGTERM_STEP``.
+    ``SCALETORCH_TPU_FT_FAIL_SAVES``, ``SCALETORCH_TPU_FT_SIGTERM_STEP``,
+    ``SCALETORCH_TPU_FT_SIGTERM_HOST``, ``SCALETORCH_TPU_FT_HANG_STEP``,
+    ``SCALETORCH_TPU_FT_BAD_BATCH_STEP``.
     """
 
     nan_at_step: int = 0
     fail_saves: int = 0
     sigterm_at_step: int = 0
+    sigterm_host: int = -1
+    hang_at_step: int = 0
+    hang_seconds: float = 120.0
+    bad_batch_at_step: int = 0
+    # host identity for the one-host drills; None = resolve from the JAX
+    # runtime lazily (fake-host tests set it explicitly)
+    host_index: Optional[int] = None
+    # signal delivery override for simulated hosts (tests route this to a
+    # host-local PreemptionHandler.trigger; None = real os.kill)
+    deliver_signal: Optional[Callable[[int], None]] = field(
+        default=None, repr=False)
     nan_fired_step: Optional[int] = field(default=None, repr=False)
     _nan_fired: bool = field(default=False, repr=False)
     _sigterm_fired: bool = field(default=False, repr=False)
+    _hang_fired: bool = field(default=False, repr=False)
 
     @classmethod
     def from_config(cls, cfg) -> "FaultInjector":
-        from scaletorch_tpu.env import get_env
+        from scaletorch_tpu.env import env_override
 
-        def env_or(name: str, cfg_field: str) -> int:
-            # A PRESENT env var always wins — including an explicit 0,
-            # so a restarted job can CANCEL a config-armed drill
-            # (FT_SIGTERM_STEP=0) without a config edit.
-            if os.environ.get(name) is not None:
-                return int(get_env(name))
-            return int(getattr(cfg, cfg_field, 0))
+        def env_or(name: str, cfg_field: str, default: int = 0) -> int:
+            # present-wins (an explicit 0 CANCELS a config-armed drill):
+            # the shared contract lives in env.env_override
+            return int(env_override(
+                name, getattr(cfg, cfg_field, default)))
 
         return cls(
             nan_at_step=env_or("SCALETORCH_TPU_FT_NAN_STEP",
@@ -329,12 +348,30 @@ class FaultInjector:
                               "ft_fail_saves"),
             sigterm_at_step=env_or("SCALETORCH_TPU_FT_SIGTERM_STEP",
                                    "ft_sigterm_at_step"),
+            sigterm_host=env_or("SCALETORCH_TPU_FT_SIGTERM_HOST",
+                                "ft_sigterm_host", default=-1),
+            hang_at_step=env_or("SCALETORCH_TPU_FT_HANG_STEP",
+                                "ft_hang_at_step"),
+            hang_seconds=float(getattr(cfg, "ft_hang_seconds", 120.0)),
+            bad_batch_at_step=env_or("SCALETORCH_TPU_FT_BAD_BATCH_STEP",
+                                     "ft_bad_batch_at_step"),
         )
 
     @property
     def active(self) -> bool:
         return bool(self.nan_at_step or self.fail_saves
-                    or self.sigterm_at_step)
+                    or self.sigterm_at_step or self.hang_at_step
+                    or self.bad_batch_at_step)
+
+    def _host(self) -> int:
+        if self.host_index is not None:
+            return self.host_index
+        try:
+            import jax
+
+            return jax.process_index()
+        except Exception:
+            return 0
 
     def corrupt_metrics(self, step: int, metrics: Dict[str, Any]
                         ) -> Dict[str, Any]:
@@ -351,11 +388,37 @@ class FaultInjector:
     def maybe_sigterm(self, step: int) -> None:
         if self.sigterm_at_step and step == self.sigterm_at_step \
                 and not self._sigterm_fired:
+            if self.sigterm_host >= 0 and self._host() != self.sigterm_host:
+                return  # the drill preempts exactly one worker
             self._sigterm_fired = True
             get_logger().warning(
                 f"fault injection: SIGTERM after step {step}"
+                + (f" on host {self.sigterm_host}"
+                   if self.sigterm_host >= 0 else "")
             )
-            os.kill(os.getpid(), signal.SIGTERM)
+            if self.deliver_signal is not None:
+                self.deliver_signal(signal.SIGTERM)
+            else:
+                os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_hang(self, step: int) -> None:
+        """Stall the step boundary once (simulated dead collective) so
+        the hang watchdog's fire-dump-exit path is testable end to end."""
+        if self.hang_at_step and step == self.hang_at_step \
+                and not self._hang_fired:
+            self._hang_fired = True
+            get_logger().warning(
+                f"fault injection: hanging for {self.hang_seconds:g}s "
+                f"after step {step}"
+            )
+            time.sleep(self.hang_seconds)
+
+    def take_bad_read(self, position: int) -> bool:
+        """True when the batch read at absolute stream ``position`` must
+        fail. Deliberately NOT consumed-once: a corrupt shard fails every
+        retry, which is exactly what forces the skip-and-log path."""
+        return bool(self.bad_batch_at_step
+                    and position == self.bad_batch_at_step)
 
     def take_save_failure(self) -> bool:
         """Consume one injected save failure (CheckpointManager calls this
@@ -470,6 +533,7 @@ class ResilienceManager:
                     "the update if it was non-finite)"
                 )
         self.injector.maybe_sigterm(step)
+        self.injector.maybe_hang(step)
         return metrics, action
 
     def counters(self) -> Dict[str, float]:

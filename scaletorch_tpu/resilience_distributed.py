@@ -1,0 +1,553 @@
+"""Multi-host resilience: coordinated control decisions, hang watchdog,
+crash reports.
+
+PR 1's fault-tolerance layer was deliberately single-host: every control
+decision (sentinel skip/rollback/abort, preemption stop, checkpoint
+retry) was made per-process, and on a multi-host run a one-sided decision
+desyncs orbax's cross-host collectives and wedges the pod. This module
+lifts those gates:
+
+  * ``DecisionBus`` — the tiny collective transport every coordinated
+    decision rides (``dist.all_gather_object`` + ``broadcast_object_list``
+    by default; injectable so N simulated hosts can share a fake bus in
+    hermetic single-process tests).
+  * ``CoordinatedResilience`` — host 0 forms each control decision from
+    the all-gathered per-host observations and broadcasts it; every host
+    executes the identical action in lockstep. Any host's SIGTERM becomes
+    a *collective* stop; any host's anomalous loss becomes a collective
+    skip/rollback/abort.
+  * ``HangWatchdog`` — a background heartbeat thread. The train loop
+    beats it at each phase (data fetch, step dispatch, checkpoint); if no
+    progress lands within the timeout the watchdog dumps every Python
+    thread stack plus the monitor ring buffer to a crash report and exits
+    with ``WATCHDOG_EXIT_CODE`` so launchers restart the job instead of
+    hanging forever on a dead collective.
+  * ``write_crash_report`` — one JSON post-mortem per abort path
+    (sentinel abort, rollback budget exhausted, watchdog fired) under
+    ``results/crash_report_step<N>.json`` so diagnosis never depends on
+    scrollback.
+
+Exit-code contract (documented in docs/fault_tolerance.md and consumed
+by scripts/launch_multihost.sh):
+
+  * 0   — graceful, including a preempted run that saved its state
+  * 42  — ``TrainingDivergedError`` (sentinel abort / budget exhausted)
+  * 43  — hang watchdog fired (restartable: state is on disk up to the
+          last periodic/emergency checkpoint)
+  * 130 — operator KeyboardInterrupt
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import math
+import os
+import sys
+import threading
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from scaletorch_tpu.resilience import (
+    ResilienceManager,
+    TrainingDivergedError,
+)
+from scaletorch_tpu.utils.logger import get_logger
+
+DIVERGED_EXIT_CODE = 42
+WATCHDOG_EXIT_CODE = 43
+
+
+# --------------------------------------------------------------------------
+# Decision transport
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class DecisionBus:
+    """The collective pair every coordinated control decision rides.
+
+    Defaults to the real ``dist.py`` object collectives over the global
+    JAX runtime; tests inject barrier-backed fakes so N simulated hosts
+    run the identical protocol in one process (tests/
+    test_resilience_distributed.py FakeBus).
+    """
+
+    num_processes: int
+    process_index: int
+    all_gather: Callable[[Any], List[Any]]
+    broadcast: Callable[[list], list]  # broadcast_object_list contract
+
+    @classmethod
+    def default(cls) -> "DecisionBus":
+        import jax
+
+        from scaletorch_tpu import dist
+
+        return cls(
+            num_processes=jax.process_count(),
+            process_index=jax.process_index(),
+            all_gather=dist.all_gather_object,
+            broadcast=dist.broadcast_object_list,
+        )
+
+    @property
+    def is_main(self) -> bool:
+        return self.process_index == 0
+
+    def broadcast_from_main(self, obj: Any) -> Any:
+        """Host 0's ``obj`` on every host (non-main input is ignored)."""
+        out = self.broadcast([obj if self.is_main else None])
+        return out[0]
+
+    def agree_all(self, flag: bool) -> bool:
+        """True iff EVERY host contributed True."""
+        return all(bool(x) for x in self.all_gather(bool(flag)))
+
+    def agree_any(self, flag: bool) -> bool:
+        """True iff ANY host contributed True."""
+        return any(bool(x) for x in self.all_gather(bool(flag)))
+
+
+# --------------------------------------------------------------------------
+# Coordinated decisions
+# --------------------------------------------------------------------------
+
+
+def hang_timeout_from_config(cfg) -> float:
+    from scaletorch_tpu.env import env_override
+
+    return float(env_override(
+        "SCALETORCH_TPU_FT_HANG_TIMEOUT",
+        getattr(cfg, "ft_hang_timeout", 0.0),
+    ))
+
+
+def coordinate_from_config(cfg) -> bool:
+    from scaletorch_tpu.env import env_override
+
+    return bool(env_override(
+        "SCALETORCH_TPU_FT_COORDINATE",
+        getattr(cfg, "ft_coordinate", True),
+    ))
+
+
+class CoordinatedResilience:
+    """Host-0-forms, broadcast-executes layer over ``ResilienceManager``.
+
+    Single-process (or ``--ft_coordinate false``) this is a transparent
+    pass-through to the local manager; multi-process every control
+    decision runs one gather + one broadcast per optimizer step:
+
+      1. each host contributes ``{loss?, forced, stop}`` (the loss only
+         on sentinel-sampled steps, so non-sampled steps move one bool);
+      2. host 0 reduces the observations — the *worst* loss across hosts
+         (any non-finite wins, else the max) feeds ITS sentinel, any
+         host's stop flag arms the collective stop — and broadcasts the
+         decision ``{action, loss, stop, abort?}``;
+      3. every host executes the identical action. Non-main hosts replay
+         the agreed loss through their own sentinel so EMA/counters stay
+         bit-identical across the fleet; if a drifted host disagrees
+         with the broadcast action it logs and obeys host 0.
+
+    A rollback additionally agrees on the restore OUTCOME: all hosts
+    restored → proceed; none → downgrade to skip; a mixed result means
+    the fleet state has diverged and every host raises identically.
+    """
+
+    def __init__(
+        self,
+        manager: ResilienceManager,
+        *,
+        bus: Optional[DecisionBus] = None,
+        enabled: bool = True,
+    ) -> None:
+        self.manager = manager
+        self.enabled = enabled
+        self._bus = bus
+        self._bus_probed = bus is not None
+        self._warned_disagreement = False
+        # stop flag agreed by the LAST after_step decision (same gather —
+        # the boundary poll reuses it instead of a second collective)
+        self._stop_agreed: Optional[bool] = None
+
+    @classmethod
+    def from_config(cls, cfg, manager: ResilienceManager
+                    ) -> "CoordinatedResilience":
+        return cls(manager, enabled=coordinate_from_config(cfg))
+
+    @property
+    def bus(self) -> Optional[DecisionBus]:
+        # probe the runtime exactly once — this sits on the per-step hot
+        # path (should_stop/after_step -> coordinated -> bus)
+        if not self._bus_probed and self.enabled:
+            self._bus_probed = True
+            bus = DecisionBus.default()
+            if bus.num_processes > 1:
+                self._bus = bus
+        return self._bus
+
+    @property
+    def coordinated(self) -> bool:
+        return (self.enabled and self.bus is not None
+                and self.bus.num_processes > 1)
+
+    # -- stop agreement ----------------------------------------------------
+
+    def should_stop(self) -> bool:
+        """Collective stop poll: any host's preemption request stops every
+        host at the SAME step boundary (the one-sided emergency save that
+        would wedge orbax's collectives can no longer happen). The stop
+        flag normally rides the previous ``after_step`` decision's gather
+        — one collective round per step total; only a boundary with no
+        prior decision (the first loop iteration) pays its own gather."""
+        if not self.coordinated:
+            return self.manager.stop_requested
+        agreed = self._stop_agreed
+        self._stop_agreed = None
+        if agreed is None:
+            agreed = self.bus.agree_any(self.manager.stop_requested)
+        return agreed
+
+    def verify_agreement(self, name: str, value: Any) -> None:
+        """Assert every host holds the identical ``value`` (e.g. the
+        emergency-checkpoint step) — a mismatch means the lockstep
+        invariant broke and entering a collective save would wedge, so
+        every host raises the same error instead."""
+        if not self.coordinated:
+            return
+        values = self.bus.all_gather(value)
+        if any(v != values[0] for v in values[1:]):
+            raise TrainingDivergedError(
+                f"multi-host disagreement on {name}: per-host values "
+                f"{values} — refusing to enter a cross-host collective "
+                "from divergent states"
+            )
+
+    # -- per-step decision -------------------------------------------------
+
+    def after_step(
+        self,
+        step: int,
+        metrics: Dict[str, Any],
+        *,
+        rollback: Optional[Callable[[], bool]] = None,
+        position: Optional[int] = None,
+    ) -> tuple:
+        """Coordinated replacement for ``ResilienceManager.after_step``;
+        same ``(metrics, action)`` contract. ``position`` is this host's
+        absolute data-stream position: a host-local skip of an unreadable
+        region (data/dataloader.py) silently desyncs the stream — every
+        later gradient averages mismatched batches — so positions ride
+        the same gather and any disagreement aborts the fleet loudly."""
+        mgr = self.manager
+        if not self.coordinated:
+            return mgr.after_step(step, metrics, rollback=rollback)
+
+        metrics = mgr.injector.corrupt_metrics(step, metrics)
+        # injected faults fire BEFORE the observation gather so a
+        # one-host SIGTERM rides THIS decision's stop flag (collective
+        # stop at the next boundary), and an injected hang stalls this
+        # host inside the collective — exactly the dead-peer shape the
+        # watchdog exists for
+        mgr.injector.maybe_sigterm(step)
+        mgr.injector.maybe_hang(step)
+        forced = mgr.injector.nan_fired_step == step
+        sampled = (
+            mgr.sentinel is not None and mgr.sentinel_frequency > 0
+            and (forced or step % mgr.sentinel_frequency == 0)
+        )
+        local = {
+            "loss": float(metrics["loss"]) if sampled else None,
+            "forced": forced,
+            "stop": mgr.stop_requested,
+            "position": position,
+        }
+        observations = self.bus.all_gather(local)
+        decision = None
+        if self.bus.is_main:
+            decision = self._form_decision(step, observations)
+        decision = self.bus.broadcast_from_main(decision)
+        # cache the agreed stop flag for the boundary poll (one
+        # collective round per step; abort below makes it moot)
+        self._stop_agreed = bool(decision.get("stop"))
+        action = self._execute_decision(step, decision, rollback)
+        return metrics, action
+
+    def _form_decision(self, step: int, observations: List[dict]) -> dict:
+        """Host 0 only: reduce per-host observations into one decision."""
+        mgr = self.manager
+        positions = {o.get("position") for o in observations
+                     if o.get("position") is not None}
+        if len(positions) > 1:
+            return {
+                "abort": (
+                    f"data stream desynced across hosts at step {step}: "
+                    f"per-host loader positions {sorted(positions)} — a "
+                    "host-local skip of an unreadable region left the "
+                    "fleet training on mismatched batches"
+                ),
+                "action": "ok", "loss": None,
+                "stop": any(o["stop"] for o in observations),
+            }
+        losses = [o["loss"] for o in observations if o["loss"] is not None]
+        stop_any = any(o["stop"] for o in observations)
+        agreed_loss: Optional[float] = None
+        if losses:
+            nonfinite = [x for x in losses if not math.isfinite(x)]
+            agreed_loss = nonfinite[0] if nonfinite else max(losses)
+        decision: Dict[str, Any] = {
+            "action": "ok", "loss": agreed_loss, "stop": stop_any,
+        }
+        if agreed_loss is None or mgr.sentinel is None:
+            return decision
+        try:
+            action = mgr.sentinel.observe(agreed_loss, step)
+            if action == "rollback":
+                mgr.sentinel.ensure_rollback_budget()
+            decision["action"] = action
+        except TrainingDivergedError as exc:
+            decision["abort"] = str(exc)
+        return decision
+
+    def _execute_decision(
+        self,
+        step: int,
+        decision: dict,
+        rollback: Optional[Callable[[], bool]],
+    ) -> str:
+        mgr = self.manager
+        loss = decision.get("loss")
+        action = decision.get("action", "ok")
+        # Non-main hosts replay the AGREED loss through their own sentinel
+        # so EMA / consecutive / counters stay identical fleet-wide; a
+        # drifted host's local verdict never overrides the broadcast.
+        if (not self.bus.is_main and loss is not None
+                and mgr.sentinel is not None):
+            try:
+                local_action = mgr.sentinel.observe(loss, step)
+            except TrainingDivergedError:
+                local_action = "abort"
+            expected = "abort" if "abort" in decision else action
+            if local_action != expected and not self._warned_disagreement:
+                self._warned_disagreement = True
+                get_logger().warning(
+                    f"host {self.bus.process_index} sentinel disagrees at "
+                    f"step {step} (local {local_action!r} vs broadcast "
+                    f"{expected!r}): obeying host 0"
+                )
+        if "abort" in decision:
+            raise TrainingDivergedError(decision["abort"])
+        if action == "rollback":
+            restored = bool(rollback()) if rollback is not None else False
+            outcomes = self.bus.all_gather(restored)
+            if all(outcomes):
+                if mgr.sentinel is not None:
+                    mgr.sentinel.note_rollback()
+            elif not any(outcomes):
+                get_logger().warning(
+                    "coordinated rollback requested but no host restored "
+                    "a checkpoint: skipping the anomalous step instead"
+                )
+                action = "skip"
+            else:
+                # some hosts restored, some did not: params now differ
+                # across the fleet — continuing would train a franken-model
+                raise TrainingDivergedError(
+                    f"rollback diverged across hosts at step {step}: "
+                    f"per-host restore outcomes {outcomes}"
+                )
+        if action == "skip" and loss is not None:
+            get_logger().warning(
+                f"anomalous loss {loss} at step {step}: batch skipped "
+                "fleet-wide (the in-step guard rejected the update if it "
+                "was non-finite)"
+            )
+        return action
+
+    def counters(self) -> Dict[str, float]:
+        return self.manager.counters()
+
+
+# --------------------------------------------------------------------------
+# Hang watchdog
+# --------------------------------------------------------------------------
+
+
+def dump_thread_stacks() -> Dict[str, str]:
+    """Formatted Python stacks of every live thread, keyed by name —
+    the first thing a dead-collective post-mortem needs (which frame is
+    sitting inside the wedged all-reduce?)."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, str] = {}
+    for ident, frame in sys._current_frames().items():
+        name = names.get(ident, f"thread-{ident}")
+        out[name] = "".join(traceback.format_stack(frame))
+    return out
+
+
+class HangWatchdog:
+    """Background heartbeat monitor: no ``beat()`` within ``timeout``
+    seconds → dump thread stacks + crash report, then ``exit_fn(43)``.
+
+    The default ``exit_fn`` is ``os._exit`` on purpose: a hang usually
+    means a thread is wedged inside a dead cross-host collective, and a
+    polite ``sys.exit`` from a daemon thread would never unwind it —
+    the launcher's restart policy is the recovery path, and state is on
+    disk up to the last checkpoint. Tests inject a recorder instead.
+    """
+
+    def __init__(
+        self,
+        timeout: float,
+        *,
+        poll_interval: Optional[float] = None,
+        crash_report: Optional[Callable[[dict], Optional[str]]] = None,
+        exit_fn: Callable[[int], None] = os._exit,
+        exit_code: int = WATCHDOG_EXIT_CODE,
+    ) -> None:
+        if timeout <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout}")
+        self.timeout = timeout
+        self.poll_interval = (
+            poll_interval if poll_interval is not None
+            else max(0.05, min(timeout / 4.0, 5.0))
+        )
+        self.crash_report = crash_report
+        self.exit_fn = exit_fn
+        self.exit_code = exit_code
+        self.fired = False
+        self.last_step: Optional[int] = None
+        self.last_phase: str = "start"
+        self._last_beat = time.monotonic()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def beat(self, step: Optional[int] = None, phase: str = "step") -> None:
+        """Record progress (cheap; called from the train loop's phases)."""
+        if step is not None:
+            self.last_step = step
+        self.last_phase = phase
+        self._last_beat = time.monotonic()
+
+    def start(self) -> "HangWatchdog":
+        self._last_beat = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, name="scaletorch-hang-watchdog", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(1.0, self.poll_interval * 4))
+            self._thread = None
+
+    def __enter__(self) -> "HangWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            stalled = time.monotonic() - self._last_beat
+            if stalled < self.timeout:
+                continue
+            self.fired = True
+            info = {
+                "reason": (
+                    f"hang watchdog: no training progress for "
+                    f"{stalled:.1f}s (timeout {self.timeout:g}s); last "
+                    f"phase {self.last_phase!r} at step {self.last_step}"
+                ),
+                "step": self.last_step,
+                "phase": self.last_phase,
+                "stalled_seconds": stalled,
+                "timeout": self.timeout,
+                "exit_code": self.exit_code,
+                "thread_stacks": dump_thread_stacks(),
+            }
+            get_logger().error(info["reason"])
+            if self.crash_report is not None:
+                try:
+                    self.crash_report(info)  # logs its own path
+                except Exception as exc:  # the exit below must still run
+                    get_logger().error(f"crash report failed: {exc!r}")
+            self.exit_fn(self.exit_code)
+            return  # injected exit_fn (tests) does not terminate us
+
+
+# --------------------------------------------------------------------------
+# Crash reports
+# --------------------------------------------------------------------------
+
+
+def config_fingerprint(cfg) -> Dict[str, Any]:
+    """Stable digest + the identity fields a post-mortem reads first."""
+    try:
+        import dataclasses as _dc
+
+        d = {k: repr(v) for k, v in sorted(_dc.asdict(cfg).items())}
+    except Exception:
+        d = {"repr": repr(cfg)}
+    digest = hashlib.sha256(
+        json.dumps(d, sort_keys=True).encode()
+    ).hexdigest()[:16]
+    keys = ("model_type", "total_train_steps", "seed", "divergence_policy",
+            "data_parallel_size", "tensor_parallel_size",
+            "pipeline_parallel_size", "context_parallel_size",
+            "expert_parallel_size")
+    return {
+        "sha256": digest,
+        **{k: getattr(cfg, k) for k in keys if hasattr(cfg, k)},
+    }
+
+
+def write_crash_report(
+    reason: str,
+    step: Optional[int],
+    *,
+    directory: str = "results",
+    config: Any = None,
+    monitor_records: Optional[List[dict]] = None,
+    last_metrics: Optional[List[dict]] = None,
+    counters: Optional[Dict[str, float]] = None,
+    thread_stacks: Optional[Dict[str, str]] = None,
+    process_index: int = 0,
+    extra: Optional[Dict[str, Any]] = None,
+) -> str:
+    """Persist a JSON post-mortem; returns the path. Never raises to the
+    caller's caller — an abort path must abort, not crash inside its own
+    diagnostics (I/O errors are logged and an empty path returned)."""
+    suffix = f"_proc{process_index}" if process_index else ""
+    path = os.path.join(
+        directory, f"crash_report_step{step if step is not None else 'NA'}"
+        f"{suffix}.json"
+    )
+    report = {
+        "reason": reason,
+        "step": step,
+        "time": time.time(),
+        "process_index": process_index,
+        "config_fingerprint": (
+            config_fingerprint(config) if config is not None else None
+        ),
+        "counters": counters or {},
+        "last_metrics": last_metrics or [],
+        "monitor_records": monitor_records or [],
+        "thread_stacks": thread_stacks or {},
+        **(extra or {}),
+    }
+    try:
+        os.makedirs(directory, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(report, f, indent=1, default=repr)
+    except OSError as exc:
+        get_logger().error(f"could not write crash report {path}: {exc!r}")
+        return ""
+    get_logger().error(f"crash report written to {path}")
+    return path

@@ -164,6 +164,13 @@ class MetricsLogger:
             get_logger().info(" | ".join(parts))
         return record
 
+    def ring_buffer(self, last_n: Optional[int] = None) -> list:
+        """The SystemMonitor ring buffer's retained records (crash-report
+        / post-mortem surface); [] when system telemetry is disabled."""
+        if self._monitor is None:
+            return []
+        return self._monitor.tail(last_n)
+
     def save_json(self, path: str) -> str:
         """Dump the full metrics history as JSON (reference
         PerformanceMonitor.save_stats, monitor.py:220-250)."""

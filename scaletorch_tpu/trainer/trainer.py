@@ -113,7 +113,8 @@ def build_model_config(cfg: ScaleTorchTPUArguments):
     raise ValueError(f"unknown model_type {cfg.model_type!r}")
 
 
-def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg):
+def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg,
+                     fault_injector=None):
     if cfg.synthetic_data or not cfg.dataset_name:
         from scaletorch_tpu.data.dataloader import SyntheticDataLoader
 
@@ -143,6 +144,10 @@ def build_dataloader(cfg: ScaleTorchTPUArguments, model_cfg):
         gradient_accumulation_steps=cfg.gradient_accumulation_steps,
         data_parallel_size=cfg.data_parallel_size * cfg.expert_parallel_size,
         seed=cfg.seed,
+        read_retries=cfg.data_read_retries,
+        retry_base_delay=cfg.data_retry_base_delay,
+        max_skipped_batches=cfg.data_max_skipped_batches,
+        fault_injector=fault_injector,
     )
 
 
@@ -441,7 +446,25 @@ class Trainer:
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)
 
-        self.loader = build_dataloader(cfg, self.model_cfg)
+        # Host-side resilience: divergence sentinel (policy over anomalous
+        # losses), fault injector (config/env drills), preemption handler
+        # (installed for the duration of train()). The device-side half is
+        # the nonfinite_guard traced into step_fn above. Built BEFORE the
+        # loader so the loader's corrupt-shard injection hook can bind the
+        # same injector. On multi-process runs every control decision is
+        # coordinated: host 0 forms it from the all-gathered per-host
+        # observations and broadcasts, so no host ever enters (or skips) a
+        # cross-host collective unilaterally.
+        from scaletorch_tpu.resilience import ResilienceManager
+        from scaletorch_tpu.resilience_distributed import CoordinatedResilience
+
+        self.resilience = ResilienceManager.from_config(cfg)
+        self.coordinator = CoordinatedResilience.from_config(
+            cfg, self.resilience)
+        self._watchdog = None
+
+        self.loader = build_dataloader(
+            cfg, self.model_cfg, fault_injector=self.resilience.injector)
         # batch leaves: [accum, dp*micro, seq] with batch over dp, seq over cp
         self._batch_shardings = {
             k: NamedSharding(self.mm.mesh, spec) for k, spec in batch_specs().items()
@@ -483,13 +506,6 @@ class Trainer:
         self._loader_skew = 0
         self._saved_loader_position = None
         self._wandb_logged_step = 0
-        # Host-side resilience: divergence sentinel (policy over anomalous
-        # losses), fault injector (config/env drills), preemption handler
-        # (installed for the duration of train()). The device-side half is
-        # the nonfinite_guard traced into step_fn above.
-        from scaletorch_tpu.resilience import ResilienceManager
-
-        self.resilience = ResilienceManager.from_config(cfg)
         self._train_iter = None
         self._ckpt_mgr = None
         self._eval_fn = None
@@ -540,6 +556,11 @@ class Trainer:
                 retries=self.cfg.checkpoint_retries,
                 retry_base_delay=self.cfg.checkpoint_retry_base_delay,
                 fault_injector=self.resilience.injector,
+                # multi-process: retry/fallback decisions ride the same
+                # coordination bus as the trainer's control decisions
+                decision_bus=(self.coordinator.bus
+                              if self.coordinator.coordinated else None),
+                verify=self.cfg.checkpoint_verify,
             )
         return self._ckpt_mgr
 
@@ -629,8 +650,10 @@ class Trainer:
         if batch is None:
             if self._train_iter is None:
                 self._train_iter = iter(self.loader)
+            self._beat("data_fetch")
             batch = next(self._train_iter)
         dev_batch = self._device_batch(batch)
+        self._beat("step_dispatch")
         self.params, self.opt_state, m = self.step_fn(
             self.params, self.opt_state, dev_batch
         )
@@ -663,32 +686,54 @@ class Trainer:
         last = {}
         self.preempted = False
         if self.cfg.handle_preemption:
-            if jax.process_count() == 1:
-                self.resilience.install_preemption_handler()
-            else:
-                # A one-sided emergency save would enter orbax's
-                # cross-process collective without its peers (hosts'
-                # SIGTERMs land at different step boundaries) and wedge
-                # the pod. Until the stop flag is agreed across hosts at
-                # the boundary, multi-process runs rely on the external
-                # scheduler + periodic saves (same carve-out as the
-                # checkpoint retry path, utils/checkpoint.py).
+            # Every host installs the handler; on multi-process runs the
+            # stop flag is agreed at each step boundary
+            # (CoordinatedResilience.should_stop), so one host's SIGTERM
+            # becomes a COLLECTIVE emergency save — no host enters
+            # orbax's cross-process collective without its peers. With
+            # coordination explicitly opted OUT, a one-sided emergency
+            # save would wedge the pod, so those runs keep the PR-1
+            # behaviour: no in-process handler, resume from the last
+            # periodic checkpoint via the external scheduler.
+            if jax.process_count() > 1 and not self.coordinator.coordinated:
                 self.logger.warning(
-                    "handle_preemption: in-process SIGTERM handling is "
-                    "single-host only; multi-process runs resume from "
-                    "the last periodic checkpoint instead"
+                    "handle_preemption with --ft_coordinate false on a "
+                    "multi-process run: skipping in-process SIGTERM "
+                    "handling (a one-sided emergency save would desync "
+                    "orbax's cross-host collectives); restarts resume "
+                    "from the last periodic checkpoint"
                 )
+            else:
+                self.resilience.install_preemption_handler()
+        from scaletorch_tpu.resilience import TrainingDivergedError
+        from scaletorch_tpu.resilience_distributed import (
+            HangWatchdog,
+            hang_timeout_from_config,
+        )
+
+        hang_timeout = hang_timeout_from_config(self.cfg)
+        if hang_timeout > 0 and self._watchdog is None:
+            self._watchdog = HangWatchdog(
+                hang_timeout,
+                crash_report=self._watchdog_crash_report,
+                exit_fn=self._watchdog_exit,
+            ).start()
         try:
             while self.global_step < target_step:
-                if self.resilience.stop_requested:
+                self._beat("step_boundary")
+                if self.coordinator.should_stop():
                     self._emergency_checkpoint()
                     self.preempted = True
                     break
                 m = self.step()
                 anomaly_step = self.global_step
-                m, action = self.resilience.after_step(
+                m, action = self.coordinator.after_step(
                     anomaly_step, m,
                     rollback=lambda: self._rollback_to_last_good(anomaly_step),
+                    # positions ride the decision gather: a host-local
+                    # skip of an unreadable region must abort loudly,
+                    # not silently train on mismatched batches
+                    position=self._stream_position(),
                 )
                 if action == "rollback":
                     # global_step has moved back to the restored
@@ -732,7 +777,15 @@ class Trainer:
                     and self.global_step % self.cfg.save_frequency == 0
                 ):
                     self.save_checkpoint()
+        except TrainingDivergedError as exc:
+            # every abort path leaves a post-mortem on disk — diagnosis
+            # must not depend on scrollback
+            self._write_crash_report(str(exc))
+            raise
         finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
             self.resilience.uninstall_preemption_handler()
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()  # drain any in-flight async save
@@ -770,8 +823,68 @@ class Trainer:
                     f"_vpp{self._pp_vpp}")
         return "model_order"
 
+    def _beat(self, phase: str) -> None:
+        """Feed the hang watchdog (no-op when it is not armed)."""
+        if self._watchdog is not None:
+            self._watchdog.beat(self.global_step, phase)
+
+    def _agree_all(self, flag: bool) -> bool:
+        """True iff every host holds True (identity single-process). Any
+        branch whose arms execute DIFFERENT collective sequences must be
+        taken from an agreed flag, never per-host local state."""
+        if self.coordinator.coordinated:
+            return self.coordinator.bus.agree_all(flag)
+        return bool(flag)
+
+    def _agree_any(self, flag: bool) -> bool:
+        if self.coordinator.coordinated:
+            return self.coordinator.bus.agree_any(flag)
+        return bool(flag)
+
+    def _stream_position(self) -> int:
+        """Absolute data-stream position covered so far. Loaders that
+        track their own position (advance-before-yield, skipped-region
+        accounting) are authoritative; the skew mirror keeps the
+        emergency-save staleness check coherent either way."""
+        position = getattr(self.loader, "position", None)
+        if position is None:
+            return self.global_step + self._loader_skew
+        self._loader_skew = position - self.global_step
+        return position
+
+    def _write_crash_report(self, reason: str,
+                            thread_stacks=None) -> str:
+        from scaletorch_tpu.resilience_distributed import write_crash_report
+
+        return write_crash_report(
+            reason,
+            self.global_step,
+            directory=self.cfg.crash_report_dir,
+            config=self.cfg,
+            monitor_records=self.metrics.ring_buffer(),
+            last_metrics=self.metrics.history[-5:],
+            counters=self.resilience.counters(),
+            thread_stacks=thread_stacks,
+            process_index=(self.coordinator.bus.process_index
+                           if self.coordinator.coordinated
+                           else jax.process_index()),
+        )
+
+    def _watchdog_crash_report(self, info: dict) -> str:
+        """HangWatchdog callback: persist the post-mortem (thread stacks
+        + monitor ring buffer + config fingerprint) before the exit."""
+        return self._write_crash_report(
+            info["reason"], thread_stacks=info.get("thread_stacks"),
+        )
+
+    # separate hook so hermetic tests can record the exit instead of
+    # killing the test process; os._exit (not sys.exit) because a thread
+    # wedged in a dead collective would never unwind a SystemExit
+    _watchdog_exit = staticmethod(os._exit)
+
     def save_checkpoint(self) -> bool:
-        position = self.global_step + self._loader_skew
+        self._beat("checkpoint")
+        position = self._stream_position()
         saved = self.checkpoint_manager.save(
             step=self.global_step,
             params=self.params,
@@ -841,17 +954,24 @@ class Trainer:
         # (not yet visible to latest_step) would otherwise finalize after
         # the restore and resurface as a stale newest checkpoint carrying
         # the pre-rollback loader position.
+        # Agree BEFORE any host can return early: a host whose directory
+        # listing transiently shows nothing (list-after-write lag, racing
+        # retention sweep) must not skip the restore collectives its
+        # peers are about to enter — either every host rolls back or
+        # every host downgrades to skip.
         self.checkpoint_manager.wait()
-        if self.checkpoint_manager.latest_step() is None:
+        if not self._agree_all(
+                self.checkpoint_manager.latest_step() is not None):
             return False
         self.logger.warning(
             f"divergence at step {anomaly_step}: rolling back to the last "
             "good checkpoint and fast-forwarding the data stream"
         )
         # The anomalous batch's TRUE stream position accounts for skew
-        # accumulated by earlier rollbacks — capture it before
-        # load_checkpoint overwrites the skew from the checkpoint.
-        bad_position = anomaly_step + self._loader_skew
+        # accumulated by earlier rollbacks AND unreadable regions the
+        # loader already skipped — capture it before load_checkpoint
+        # overwrites the skew from the checkpoint.
+        bad_position = self._stream_position()
         if not self.load_checkpoint():
             return False
         # fast-forward PAST the bad region and remember the skew so later
@@ -880,9 +1000,20 @@ class Trainer:
             )
             self.emergency_checkpoint_saved = False
             return False
-        if (self.checkpoint_manager.latest_step() == self.global_step
+        # Multi-host: every host must be saving the SAME step — a
+        # mismatch means the lockstep invariant broke and entering the
+        # collective save would wedge, so fail loudly instead.
+        self.coordinator.verify_agreement(
+            "emergency_checkpoint_step", self.global_step)
+        self._beat("emergency_checkpoint")
+        # Every branch below is taken from an AGREED flag: a per-host
+        # directory-listing race (list-after-write lag) must not send
+        # hosts down arms with different collective sequences — same
+        # treatment as the rollback path above.
+        if self._agree_all(
+                self.checkpoint_manager.latest_step() == self.global_step
                 and self._saved_loader_position
-                == self.global_step + self._loader_skew):
+                == self._stream_position()):
             # the save cadence already covered this boundary — same step
             # AND same loader position (a rollback can change the skew
             # after the step was saved, making the on-disk checkpoint
@@ -891,7 +1022,8 @@ class Trainer:
             # directory before trusting it (wait() swallows async
             # failures by degrading to sync).
             self.checkpoint_manager.wait()
-            if self.checkpoint_manager.latest_step() == self.global_step:
+            if self._agree_all(self.checkpoint_manager.latest_step()
+                               == self.global_step):
                 self.logger.warning(
                     f"preemption requested (signal {sig}): step "
                     f"{self.global_step} is already checkpointed; exiting"
@@ -899,17 +1031,34 @@ class Trainer:
                 self.emergency_checkpoint_saved = True
                 return True
             # the in-flight save failed — fall through to a fresh save
-        if self.checkpoint_manager.latest_step() == self.global_step:
+        if self._agree_any(self.checkpoint_manager.latest_step()
+                           == self.global_step):
             # same step number but STALE content (e.g. the loader skew
             # changed after a rollback): orbax silently skips same-step
-            # saves, so the stale one must be deleted to be replaced
-            try:
-                self.checkpoint_manager.delete(self.global_step)
-            except Exception as exc:
-                self.logger.error(
-                    f"could not replace stale checkpoint at step "
-                    f"{self.global_step}: {exc!r}"
-                )
+            # saves, so the stale one must be deleted to be replaced.
+            # Shared directory: exactly one host performs the delete.
+            if (not self.coordinator.coordinated
+                    or self.coordinator.bus.is_main):
+                try:
+                    self.checkpoint_manager.delete(self.global_step)
+                except Exception as exc:
+                    self.logger.error(
+                        f"could not replace stale checkpoint at step "
+                        f"{self.global_step}: {exc!r}"
+                    )
+            if self.coordinator.coordinated:
+                # every host must SEE the retirement before saving:
+                # orbax's monotonic should_save on a host whose listing
+                # still shows the step would silently no-op while its
+                # peers enter the real save collective (bounded wait —
+                # a failed delete falls through to the save attempt,
+                # whose agreed outcome handles the skip symmetrically)
+                for _ in range(50):
+                    if self._agree_all(
+                            self.checkpoint_manager.latest_step()
+                            != self.global_step):
+                        break
+                    time.sleep(0.1)
         self.logger.warning(
             f"preemption requested (signal {sig}): writing emergency "
             f"checkpoint at step {self.global_step}"
@@ -918,7 +1067,8 @@ class Trainer:
         self.checkpoint_manager.wait()
         # wait() may have degraded async->sync after a pool failure; the
         # directory listing is the ground truth for "is my step on disk"
-        saved = saved and (
-            self.checkpoint_manager.latest_step() == self.global_step)
+        # — and the verdict must be fleet-wide, not per-host
+        saved = self._agree_all(saved and (
+            self.checkpoint_manager.latest_step() == self.global_step))
         self.emergency_checkpoint_saved = saved
         return saved

@@ -15,6 +15,17 @@ corrupted/partial latest checkpoint falls back to the previous step on
 restore. A retriable save failure NEVER propagates — losing one
 checkpoint is recoverable, losing the run is not.
 
+Multi-host semantics: orbax save/restore are CROSS-PROCESS collectives, so
+a host-local retry or async→sync fallback would re-enter the collective
+without its peers and wedge or desync the run. With a ``DecisionBus``
+(resilience_distributed.py) the retry decision is itself collective: every
+host attempts, the per-host outcomes are all-gathered, and retry /
+degrade / give-up happen in lockstep on every host. Without a bus,
+multi-process runs keep the pre-hardening one-attempt semantics
+(exceptions propagate symmetrically). A wedged PEER (one that never
+returns from the collective) is out of scope here — that is the hang
+watchdog's job.
+
 HF-safetensors interop (load-time materialization with TP/PP/EP slicing,
 reference checkpoint.py:23-464) lives in utils/hf_interop.py.
 """
@@ -22,13 +33,26 @@ reference checkpoint.py:23-464) lives in utils/hf_interop.py.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
 
 from scaletorch_tpu.resilience import retry_with_backoff
 from scaletorch_tpu.utils.logger import get_logger
+
+
+def _tree_spec(tree: Any) -> List[Tuple[str, Tuple[int, ...], str]]:
+    """Flatten a pytree into (path, shape, dtype) rows for structural
+    comparison against orbax metadata."""
+    rows = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        shape = tuple(getattr(leaf, "shape", ()))
+        dtype = str(getattr(leaf, "dtype", type(leaf).__name__))
+        rows.append((key, shape, dtype))
+    return sorted(rows)
 
 
 class CheckpointManager:
@@ -42,6 +66,8 @@ class CheckpointManager:
         retries: int = 3,
         retry_base_delay: float = 0.5,
         fault_injector: Optional[Any] = None,
+        decision_bus: Optional[Any] = None,
+        verify: bool = False,
     ) -> None:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -49,17 +75,27 @@ class CheckpointManager:
         self._async = async_save
         self.retries = retries
         self.retry_base_delay = retry_base_delay
-        # orbax save/restore are CROSS-PROCESS collectives on multi-host
-        # runs: a host-local retry or async->sync fallback would re-enter
-        # the collective without its peers and wedge or desync the run.
-        # Until the retry decision is itself coordinated, multi-process
-        # runs keep the pre-hardening semantics (one attempt, exceptions
-        # propagate symmetrically on every host).
         self._single_process = jax.process_count() == 1
+        # resilience_distributed.DecisionBus (or None): when present on a
+        # multi-process run, retry/fallback decisions are agreed across
+        # hosts instead of being forfeited (see module docstring).
+        self._bus = (
+            decision_bus
+            if decision_bus is not None and decision_bus.num_processes > 1
+            else None
+        )
+        # post-save integrity verification (opt-in): read back the saved
+        # tree STRUCTURE and compare against the in-memory spec, so a
+        # torn/mangled write is caught at save time, not restore time.
+        self._verify = verify
         # resilience.FaultInjector (or None): lets tests/drills fail the
         # first n save attempts with a retriable error.
         self._injector = fault_injector
         self._mgr = self._make_mgr()
+
+    @property
+    def _coordinated(self) -> bool:
+        return self._bus is not None
 
     def _make_mgr(self) -> ocp.CheckpointManager:
         options = ocp.CheckpointManagerOptions(
@@ -81,6 +117,8 @@ class CheckpointManager:
             pass  # the pool may already be dead; that's why we're here
         self._async = False
         self._mgr = self._make_mgr()
+
+    # -- save --------------------------------------------------------------
 
     def save(
         self,
@@ -108,8 +146,17 @@ class CheckpointManager:
                 ),
             ))
 
-        if not self._single_process:
-            return attempt()  # collective: no host-local retry (see __init__)
+        if self._coordinated:
+            saved = self._coordinated_save(attempt, step)
+        elif not self._single_process:
+            saved = attempt()  # no bus: one symmetric collective attempt
+        else:
+            saved = self._single_process_save(attempt, step)
+        if saved and self._verify:
+            saved = self._verify_after_save(step, params, opt_state)
+        return saved
+
+    def _single_process_save(self, attempt, step: int) -> bool:
         try:
             # like the restore path: only transient I/O earns backoff
             # sleeps — a deterministic bug (serialization TypeError,
@@ -142,21 +189,162 @@ class CheckpointManager:
             )
             return False
 
-    def wait(self) -> None:
-        """Drain in-flight async writes; an async failure surfaces here —
-        degrade to synchronous saving instead of crashing the run
-        (single-process only; multi-host degradation must stay symmetric
-        across hosts, see __init__)."""
-        if not self._single_process:
-            self._mgr.wait_until_finished()
-            return
+    def _coordinated_save(self, attempt, step: int) -> bool:
+        """Collective retry: every host attempts, outcomes are
+        all-gathered, and the retry/degrade/give-up choice is identical
+        on every host (no one-sided re-entry into orbax's collective).
+        The agreement covers the BOOLEAN result too, not just
+        exceptions: orbax's should_save silently returns False when a
+        host's directory view already lists the step, and a saved=True/
+        saved=False split would route only some hosts into the
+        verification collective — wedging the fleet."""
+        out = False
+        for attempt_no in range(self.retries + 1):
+            err: Optional[Exception] = None
+            try:
+                out = attempt()
+            except Exception as exc:
+                err = exc
+            statuses = self._bus.all_gather(
+                "err" if err is not None else ("saved" if out else "skipped")
+            )
+            if all(s == "saved" for s in statuses):
+                return True
+            if all(s == "skipped" for s in statuses):
+                return False  # symmetric no-op (step exists everywhere)
+            # mixed saved/skipped (stale directory views) retries like an
+            # error: the pre-retry retirement below clears local copies
+            # so the re-attempt converges on all-saved
+            if err is not None:
+                get_logger().warning(
+                    f"checkpoint save (step {step}) attempt "
+                    f"{attempt_no + 1}/{self.retries + 1} failed locally: "
+                    f"{err!r}"
+                )
+            if attempt_no >= self.retries:
+                break
+            if self._async:
+                # any host's failure may be its async pool: degrade to
+                # sync on EVERY host so semantics stay symmetric
+                self._fallback_to_sync()
+            # a host whose attempt locally succeeded holds a partial/
+            # uncommitted copy of the step; retire it so the collective
+            # re-attempt isn't silently skipped as "step exists"
+            try:
+                if step in self._mgr.all_steps():
+                    self._mgr.delete(step)
+            except Exception:
+                pass  # racing peers on shared storage; retry decides
+            time.sleep(self.retry_base_delay * (2 ** attempt_no))
+        get_logger().error(
+            f"coordinated checkpoint save at step {step} failed on at "
+            "least one host after retries; training continues without "
+            "this checkpoint"
+        )
+        return False
+
+    # -- post-save verification -------------------------------------------
+
+    def _verify_after_save(self, step: int, params: Any, opt_state: Any
+                           ) -> bool:
+        """Read back the saved metadata/tree structure and compare with
+        the in-memory spec; a mismatch retires the step through the same
+        path as an unreadable checkpoint so the corruption is discovered
+        NOW, not at restore time. Verification drains in-flight async
+        writes (metadata is only on disk after the commit). Every early
+        exit happens AFTER the coordinated agreement — a one-sided
+        return would leave peers blocked in a gather no one answers."""
+        err: Optional[Exception] = None
         try:
             self._mgr.wait_until_finished()
         except Exception as exc:
-            get_logger().error(
-                f"async checkpoint write failed: {exc!r}"
-            )
+            err = exc
+        drained = err is None
+        if self._coordinated:
+            drained = all(self._bus.all_gather(drained))
+        if not drained:
+            if err is not None:
+                get_logger().error(
+                    f"checkpoint verification: async drain failed: {err!r}"
+                )
+            # symmetric degradation: every host (or the lone one) leaves
+            # async mode together
             self._fallback_to_sync()
+            return False
+        mismatch = self._verify_mismatch(step, params, opt_state)
+        ok = mismatch is None
+        if self._coordinated:
+            ok = all(self._bus.all_gather(ok))
+        if ok:
+            return True
+        if mismatch:
+            get_logger().error(
+                f"checkpoint at step {step} failed post-save "
+                f"verification: {mismatch}; retiring it"
+            )
+        if not self._coordinated or self._bus.is_main:
+            # shared directory: exactly one host performs the retirement
+            try:
+                self._mgr.delete(step)
+            except Exception as exc:
+                get_logger().error(
+                    f"could not retire unverified checkpoint at step "
+                    f"{step}: {exc!r}"
+                )
+        return False
+
+    def _verify_mismatch(self, step: int, params: Any, opt_state: Any
+                         ) -> Optional[str]:
+        """None when the on-disk structure matches; else a description."""
+        try:
+            md = self._mgr.item_metadata(step)
+        except Exception as exc:
+            return f"metadata unreadable ({exc!r})"
+        for name, tree in (("params", params), ("opt_state", opt_state)):
+            saved = getattr(md, name, None)
+            if saved is None:
+                return f"{name} metadata missing"
+            try:
+                disk = _tree_spec(saved)
+                mem = _tree_spec(tree)
+            except Exception as exc:
+                return f"{name} metadata unparsable ({exc!r})"
+            if [r[0] for r in disk] != [r[0] for r in mem]:
+                return (f"{name} tree structure differs "
+                        f"(saved {len(disk)} leaves vs {len(mem)})")
+            for (k, ds, dd), (_, ms, mdt) in zip(disk, mem):
+                if tuple(ds) != tuple(ms):
+                    return f"{name}{k}: shape {ds} on disk vs {ms} in memory"
+                if str(dd) != str(mdt):
+                    return f"{name}{k}: dtype {dd} on disk vs {mdt} in memory"
+        return None
+
+    # -- drain -------------------------------------------------------------
+
+    def wait(self) -> None:
+        """Drain in-flight async writes; an async failure surfaces here —
+        degrade to synchronous saving instead of crashing the run. With a
+        DecisionBus the degradation choice is agreed across hosts; bare
+        multi-process keeps the symmetric-propagation semantics."""
+        err: Optional[Exception] = None
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as exc:
+            err = exc
+        if self._coordinated:
+            if not all(self._bus.all_gather(err is None)):
+                if err is not None:
+                    get_logger().error(
+                        f"async checkpoint write failed: {err!r}"
+                    )
+                self._fallback_to_sync()  # every host degrades together
+            return
+        if err is None:
+            return
+        if not self._single_process:
+            raise err  # no bus: propagate symmetrically on every host
+        get_logger().error(f"async checkpoint write failed: {err!r}")
+        self._fallback_to_sync()
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()
@@ -168,6 +356,8 @@ class CheckpointManager:
 
     def all_steps(self) -> List[int]:
         return sorted(self._mgr.all_steps())
+
+    # -- restore -----------------------------------------------------------
 
     def _restore_step(self, step: int, params: Any, opt_state: Any
                       ) -> Dict[str, Any]:
@@ -186,6 +376,27 @@ class CheckpointManager:
             "step": step,
         }
 
+    def _retire_unreadable(self, unreadable: List[int]) -> None:
+        """Retire unreadable newer steps: while registered they stay
+        orbax's "latest", and its monotonic should_save would silently
+        reject EVERY save at a step <= that latest — the whole retrain
+        window after a fallback would go unprotected. On coordinated
+        runs only host 0 touches the (shared) directory."""
+        if self._coordinated and not self._bus.is_main:
+            return
+        for bad in unreadable:
+            try:
+                self._mgr.delete(bad)
+                get_logger().warning(
+                    f"deleted unreadable checkpoint at step {bad}"
+                )
+            except Exception as exc:
+                get_logger().error(
+                    f"could not delete unreadable checkpoint at step "
+                    f"{bad}: {exc!r}; saves below step {bad} may be "
+                    "silently skipped"
+                )
+
     def load_latest(
         self, params: Any, opt_state: Any
     ) -> Optional[Dict[str, Any]]:
@@ -193,10 +404,16 @@ class CheckpointManager:
         of the given templates; a corrupted/partial step falls back to the
         previous one. None if no checkpoint restores.
 
-        Multi-process runs restore the latest step with one collective
-        attempt and propagate failures (a per-host retry or per-host
-        fallback choice could leave hosts on DIFFERENT steps)."""
+        With a DecisionBus the step list, each retry and each fallback
+        are agreed across hosts, so every host lands on the SAME step.
+        Bare multi-process runs restore the latest step with one
+        collective attempt and propagate failures."""
         steps = sorted(self.all_steps(), reverse=True)
+        if self._coordinated:
+            # host 0's directory listing is authoritative — hosts racing
+            # a concurrent retention sweep must not disagree on "latest"
+            steps = self._bus.broadcast_from_main(steps)
+            return self._coordinated_load(steps, params, opt_state)
         if not self._single_process:
             if not steps:
                 return None
@@ -222,26 +439,46 @@ class CheckpointManager:
                 )
                 unreadable.append(step)
                 continue
-            # Retire the unreadable newer steps: while registered they
-            # stay orbax's "latest", and its monotonic should_save would
-            # silently reject EVERY save at a step <= that latest — the
-            # whole retrain window after this fallback would go
-            # unprotected, and a later crash would resume from the stale
-            # unreadable step's older sibling with a stale loader
-            # position.
-            for bad in unreadable:
-                try:
-                    self._mgr.delete(bad)
-                    get_logger().warning(
-                        f"deleted unreadable checkpoint at step {bad}"
-                    )
-                except Exception as exc:
-                    get_logger().error(
-                        f"could not delete unreadable checkpoint at step "
-                        f"{bad}: {exc!r}; saves below step {bad} may be "
-                        "silently skipped"
-                    )
+            self._retire_unreadable(unreadable)
             return out
+        return None
+
+    def _coordinated_load(self, steps: List[int], params: Any,
+                          opt_state: Any) -> Optional[Dict[str, Any]]:
+        unreadable: List[int] = []
+        for step in steps:
+            for attempt_no in range(self.retries + 1):
+                err: Optional[Exception] = None
+                out = None
+                try:
+                    out = self._restore_step(step, params, opt_state)
+                except Exception as exc:
+                    err = exc
+                statuses = self._bus.all_gather(
+                    "ok" if err is None
+                    else ("retriable" if isinstance(err, OSError)
+                          else "fatal")
+                )
+                if all(s == "ok" for s in statuses):
+                    self._retire_unreadable(unreadable)
+                    return out
+                if err is not None:
+                    get_logger().warning(
+                        f"checkpoint restore (step {step}) attempt "
+                        f"{attempt_no + 1}/{self.retries + 1} failed "
+                        f"locally: {err!r}"
+                    )
+                if ("fatal" in statuses or attempt_no >= self.retries):
+                    # deterministic corruption (or budget spent) anywhere
+                    # → every host falls back to the previous step
+                    unreadable.append(step)
+                    get_logger().warning(
+                        f"checkpoint at step {step} unreadable on at "
+                        "least one host; falling back to the previous "
+                        "checkpoint fleet-wide"
+                    )
+                    break
+                time.sleep(self.retry_base_delay * (2 ** attempt_no))
         return None
 
     def close(self) -> None:

@@ -156,6 +156,15 @@ class SystemMonitor:
         self.records.append(record)
         return record
 
+    def tail(self, last_n: Optional[int] = None) -> list:
+        """The retained records (newest last), optionally only the last
+        ``last_n`` — the crash-report dump: a wedged or diverged run's
+        post-mortem starts from this timeline."""
+        records = list(self.records)
+        if last_n is not None:
+            records = records[-last_n:]
+        return records
+
     def summary(self) -> Dict[str, float]:
         """Mean/max over the retained window, per numeric field."""
         out: Dict[str, float] = {}

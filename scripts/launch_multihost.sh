@@ -20,7 +20,15 @@
 #       python train.py --data_parallel_size 32 ...
 #
 # node_list.txt: one hostname/IP per line ('#' comments and blanks ignored).
-# Env overrides: SSH_USER, COORD_PORT (default 29500), LOG_DIR.
+# Env overrides: SSH_USER, COORD_PORT (default 29500), LOG_DIR,
+# MAX_RESTARTS (default 0).
+#
+# Exit-code contract (docs/fault_tolerance.md): a process exiting 43
+# means its hang watchdog fired on a dead collective — the job state is
+# restartable from the last checkpoint, so with MAX_RESTARTS > 0 this
+# script relaunches the whole fleet (every process must restart together:
+# the surviving processes of a wedged collective are not salvageable).
+# Exit 42 (training diverged) is NOT restarted — it needs a human.
 
 set -euo pipefail
 
@@ -55,28 +63,65 @@ cleanup() {
 }
 trap cleanup INT TERM
 
-echo "launching $NUM_NODES processes, coordinator $COORD_ADDR, logs in $LOG_DIR"
-for i in "${!NODES[@]}"; do
-    node="${NODES[$i]}"
-    log="$LOG_DIR/proc-${i}_${node}.log"
-    ssh -o StrictHostKeyChecking=no -o BatchMode=yes "$SSH_USER@$node" "
-        cd '$PWD' 2>/dev/null || true
-        export JAX_COORDINATOR_ADDRESS='$COORD_ADDR'
-        export JAX_NUM_PROCESSES='$NUM_NODES'
-        export JAX_PROCESS_ID='$i'
-        echo \$\$ > /tmp/${LAUNCH_TAG}.pid
-        exec $*
-    " > "$log" 2>&1 &
-    PIDS+=($!)
-done
+WATCHDOG_EXIT=43   # hang watchdog fired (resilience_distributed.py)
+DIVERGED_EXIT=42   # training diverged — never auto-restarted
+MAX_RESTARTS="${MAX_RESTARTS:-0}"
 
-fail=0
-for i in "${!PIDS[@]}"; do
-    if wait "${PIDS[$i]}"; then
-        echo "[ok]   process $i (${NODES[$i]})"
-    else
-        echo "[FAIL] process $i (${NODES[$i]}) — see $LOG_DIR/proc-${i}_${NODES[$i]}.log"
-        fail=1
+launch_fleet() {
+    local attempt="$1"
+    PIDS=()
+    for i in "${!NODES[@]}"; do
+        node="${NODES[$i]}"
+        log="$LOG_DIR/proc-${i}_${node}_try${attempt}.log"
+        ssh -o StrictHostKeyChecking=no -o BatchMode=yes "$SSH_USER@$node" "
+            cd '$PWD' 2>/dev/null || true
+            export JAX_COORDINATOR_ADDRESS='$COORD_ADDR'
+            export JAX_NUM_PROCESSES='$NUM_NODES'
+            export JAX_PROCESS_ID='$i'
+            echo \$\$ > /tmp/${LAUNCH_TAG}.pid
+            exec $*
+        " > "$log" 2>&1 &
+        PIDS+=($!)
+    done
+}
+
+attempt=0
+while :; do
+    echo "launching $NUM_NODES processes (attempt $((attempt + 1))), coordinator $COORD_ADDR, logs in $LOG_DIR"
+    launch_fleet "$attempt"
+    fail=0
+    watchdog_fired=0
+    diverged=0
+    for i in "${!PIDS[@]}"; do
+        # `&& rc=0 || rc=$?` keeps errexit from killing the launcher on
+        # the first non-zero child — reporting/cleanup/restart must run
+        wait "${PIDS[$i]}" && rc=0 || rc=$?
+        if [ "$rc" -eq 0 ]; then
+            echo "[ok]       process $i (${NODES[$i]})"
+        elif [ "$rc" -eq "$WATCHDOG_EXIT" ]; then
+            echo "[WATCHDOG] process $i (${NODES[$i]}) exited $rc — hang watchdog fired; see crash_report_step*.json and $LOG_DIR/proc-${i}_${NODES[$i]}_try${attempt}.log"
+            watchdog_fired=1; fail=$rc
+        elif [ "$rc" -eq "$DIVERGED_EXIT" ]; then
+            echo "[DIVERGED] process $i (${NODES[$i]}) exited $rc — training diverged; NOT restarting (see crash report)"
+            diverged=1; fail=$rc
+        else
+            echo "[FAIL]     process $i (${NODES[$i]}) exited $rc — see $LOG_DIR/proc-${i}_${NODES[$i]}_try${attempt}.log"
+            fail=$rc
+        fi
+    done
+    [ "$fail" -eq 0 ] && exit 0
+    # a fired watchdog means a dead collective: the survivors are wedged
+    # too — kill the whole fleet and relaunch it together (the job
+    # resumes from its last checkpoint via --resume auto). A diverged
+    # host (42) vetoes the restart even when its wedged peers exited 43:
+    # re-running a diverged job just re-diverges it.
+    if [ "$watchdog_fired" -eq 1 ] && [ "$diverged" -eq 0 ] \
+            && [ "$attempt" -lt "$MAX_RESTARTS" ]; then
+        attempt=$((attempt + 1))
+        echo "hang watchdog fired: restarting the fleet ($attempt/$MAX_RESTARTS)"
+        cleanup
+        sleep 5
+        continue
     fi
+    exit "$fail"
 done
-exit $fail

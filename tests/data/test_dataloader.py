@@ -85,6 +85,94 @@ class TestMicroBatchDataLoader:
         with pytest.raises(ValueError, match="seq_len"):
             MicroBatchDataLoader(np.zeros(5, dtype=np.int32), 1, 1)
 
+    def test_position_advances_before_yield(self):
+        """Regression: a crash between fetch and optimizer step must not
+        double-count the batch. Bookkeeping advances BEFORE the yield, so
+        a re-created iterator (the old one died with the exception)
+        continues exactly after the last delivered batch instead of
+        replaying the epoch from offset 0."""
+        tokens = make_tokens(64)
+        ref = MicroBatchDataLoader(tokens, 2, 1, seed=3)
+        seen = [next(iter_b) for iter_b in [iter(ref)] for _ in range(6)]
+
+        dl = MicroBatchDataLoader(tokens, 2, 1, seed=3)
+        it = iter(dl)
+        for _ in range(3):
+            next(it)
+        assert dl.position == 3
+        del it  # simulated crash mid-epoch
+        np.testing.assert_array_equal(
+            next(iter(dl))["input_ids"], seen[3]["input_ids"]
+        )
+        assert dl.position == 4
+
+    def test_set_state_aligns_position(self):
+        dl = MicroBatchDataLoader(make_tokens(64), 2, 1, seed=3)
+        dl.set_state(25)
+        assert dl.position == 25
+        next(iter(dl))
+        assert dl.position == 26
+
+
+class _BadReads:
+    """Stub injector: positions in ``bad`` are unreadable every attempt
+    (deterministic corruption, like FaultInjector.take_bad_read)."""
+
+    def __init__(self, bad):
+        self.bad = set(bad)
+        self.attempts = 0
+
+    def take_bad_read(self, position):
+        if position in self.bad:
+            self.attempts += 1
+            return True
+        return False
+
+
+class TestFaultTolerantReads:
+    def _dl(self, injector, **kw):
+        kw.setdefault("read_retries", 1)
+        kw.setdefault("retry_base_delay", 0.001)
+        return MicroBatchDataLoader(
+            make_tokens(64), 2, 1, seed=3, fault_injector=injector, **kw)
+
+    def test_corrupt_region_skipped_and_stream_stays_deterministic(self):
+        dl = self._dl(_BadReads([2]))
+        ref = MicroBatchDataLoader(make_tokens(64), 2, 1, seed=3)
+        it, ref_it = iter(dl), iter(ref)
+        got = [next(it) for _ in range(3)]
+        expected = [next(ref_it) for _ in range(4)]  # position 2 retired
+        for g, e in zip(got, [expected[0], expected[1], expected[3]]):
+            np.testing.assert_array_equal(g["input_ids"], e["input_ids"])
+        # the skipped slot still consumed a stream position — that is
+        # what keeps loader_position (and restarts) deterministic
+        assert dl.position == 4
+        assert dl.skipped_positions == [2]
+        # the corrupt read burned retries+1 attempts before the skip
+        assert dl._injector.attempts == 2
+
+    def test_transient_failure_is_retried_not_skipped(self):
+        class Flaky:
+            def __init__(self):
+                self.calls = 0
+
+            def take_bad_read(self, position):
+                self.calls += 1
+                return position == 1 and self.calls == 2  # fail once
+
+        dl = self._dl(Flaky(), read_retries=2)
+        it = iter(dl)
+        next(it)
+        b = next(it)  # position 1: first attempt fails, retry succeeds
+        assert b is not None
+        assert dl.skipped_positions == []
+
+    def test_too_many_skips_abort(self):
+        dl = self._dl(_BadReads(range(0, 10)), max_skipped_batches=3)
+        with pytest.raises(RuntimeError, match="max_skipped_batches"):
+            for _ in iter(dl):
+                pass
+
 
 class TestSyntheticDataLoader:
     def test_contract(self):

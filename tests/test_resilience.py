@@ -514,6 +514,7 @@ class ToyTrainer:
     def __init__(self, cfg: ScaleTorchTPUArguments, tokens: np.ndarray):
         from scaletorch_tpu.data.dataloader import MicroBatchDataLoader
         from scaletorch_tpu.resilience import ResilienceManager
+        from scaletorch_tpu.resilience_distributed import CoordinatedResilience
         from scaletorch_tpu.trainer.metrics import MetricsLogger
         from scaletorch_tpu.trainer.optimizer import create_optimizer
         from scaletorch_tpu.trainer.train_step import make_train_step
@@ -528,13 +529,20 @@ class ToyTrainer:
         )
         self.params = toy_params(seed=cfg.seed)
         self.opt_state = self.tx.init(self.params)
+        self.resilience = ResilienceManager.from_config(cfg)
+        self.coordinator = CoordinatedResilience.from_config(
+            cfg, self.resilience)
+        self._watchdog = None
         self.loader = MicroBatchDataLoader(
             tokens,
             micro_batch_size=cfg.micro_batch_size,
             gradient_accumulation_steps=cfg.gradient_accumulation_steps,
             seed=cfg.seed,
+            read_retries=cfg.data_read_retries,
+            retry_base_delay=cfg.data_retry_base_delay,
+            max_skipped_batches=cfg.data_max_skipped_batches,
+            fault_injector=self.resilience.injector,
         )
-        self.resilience = ResilienceManager.from_config(cfg)
         self.metrics = MetricsLogger(
             num_params=V * H * 2, num_layers=1, num_heads=1, head_dim=H,
             seq_len=SEQ, tokens_per_step=self.loader.tokens_per_step,
@@ -575,6 +583,9 @@ def _bind_real_trainer_methods():
     for name in (
         "train", "save_checkpoint", "load_checkpoint",
         "_rollback_to_last_good", "_emergency_checkpoint", "_layer_storage",
+        "_beat", "_stream_position", "_write_crash_report",
+        "_watchdog_crash_report", "_watchdog_exit",
+        "_agree_all", "_agree_any",
     ):
         setattr(ToyTrainer, name, Trainer.__dict__[name])
     ToyTrainer.checkpoint_manager = Trainer.__dict__["checkpoint_manager"]
@@ -592,7 +603,8 @@ def e2e_cfg(tmp_path=None, **kw):
         sentinel_frequency=1,
     )
     if tmp_path is not None:
-        defaults.update(checkpoint_dir=str(tmp_path), save_frequency=2)
+        defaults.update(checkpoint_dir=str(tmp_path), save_frequency=2,
+                        crash_report_dir=str(tmp_path / "crash_reports"))
     defaults.update(kw)
     return ScaleTorchTPUArguments(**defaults)
 
@@ -791,6 +803,37 @@ class TestEndToEndFaults:
         t.close()
         assert t.global_step == 6
         assert params_finite(t.params)
+
+    def test_corrupt_shard_skipped_and_retired_across_restart(self, tmp_path):
+        """An unreadable stream region (ft_bad_batch_at_step) is skipped
+        after retries, the skip is absorbed into loader_position, and a
+        restarted run keeps the region retired (no replay, no
+        double-count)."""
+        cfg = e2e_cfg(tmp_path, ft_bad_batch_at_step=2,
+                      data_read_retries=1, data_retry_base_delay=0.001)
+        t = ToyTrainer(cfg, e2e_tokens())
+        t.train()
+        t.close()
+        assert t.global_step == 6
+        # 6 optimizer steps consumed 7 stream positions (slot 2 skipped)
+        assert t.loader.position == 7
+        assert t.loader.skipped_positions == [2]
+        assert t._loader_skew == 1
+
+        t2 = ToyTrainer(e2e_cfg(tmp_path), e2e_tokens())
+        assert t2.load_checkpoint()
+        assert t2.global_step == 6 and t2._loader_skew == 1
+        t2.step()
+        from scaletorch_tpu.data.dataloader import MicroBatchDataLoader
+
+        ref_it = iter(MicroBatchDataLoader(
+            e2e_tokens(), micro_batch_size=2,
+            gradient_accumulation_steps=2, seed=cfg.seed))
+        for _ in range(8):
+            next(ref_it)
+        np.testing.assert_array_equal(
+            next(t2._train_iter)["input_ids"], next(ref_it)["input_ids"])
+        t2.close()
 
 
 # ---------------------------------------------------------------------------

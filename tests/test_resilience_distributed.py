@@ -1,0 +1,679 @@
+"""Multi-host resilience (scaletorch_tpu/resilience_distributed.py).
+
+The acceptance surface of the coordinated layer, exercised hermetically
+in one process: N simulated hosts run the REAL protocol (the same
+``CoordinatedResilience`` / ``CheckpointManager`` / ``Trainer.train``
+code paths production uses) over a barrier-backed ``FakeBus`` whose
+``all_gather``/``broadcast`` keep the ``dist.py`` object-collective
+contracts. Each simulated host is one thread; a host that deadlocks or
+desyncs breaks the barrier and fails the test instead of hanging it.
+
+Covered here:
+  * one-host SIGTERM → a collective stop + emergency checkpoint at the
+    SAME step on every host (the PR-1 ``process_count() == 1`` gate is
+    gone — asserted against the source);
+  * a sentinel rollback decision identical on all hosts, including when
+    only one host observes the anomaly;
+  * host-disagreement: a drifted host obeys host 0's broadcast;
+  * abort raised in lockstep on every host;
+  * coordinated checkpoint save retries / fleet-wide restore fallback /
+    symmetric async→sync degradation;
+  * post-save integrity verification (opt-in) retiring a mangled step;
+  * the hang watchdog: fires within the timeout, dumps thread stacks +
+    ring buffer to a crash report, exits with the documented code 43 —
+    unit and end-to-end (FaultInjector stall) variants.
+"""
+
+import glob
+import inspect
+import json
+import threading
+import time
+from functools import partial
+
+import numpy as np
+import pytest
+
+from scaletorch_tpu.resilience import (
+    DivergenceSentinel,
+    FaultInjector,
+    PreemptionHandler,
+    ResilienceManager,
+    TrainingDivergedError,
+)
+from scaletorch_tpu.resilience_distributed import (
+    DIVERGED_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    CoordinatedResilience,
+    DecisionBus,
+    HangWatchdog,
+    config_fingerprint,
+    dump_thread_stacks,
+    write_crash_report,
+)
+from tests.test_resilience import ToyTrainer, e2e_cfg, e2e_tokens
+
+pytestmark = pytest.mark.multihost
+
+
+# ---------------------------------------------------------------------------
+# Fake N-host collective bus
+# ---------------------------------------------------------------------------
+
+
+class FakeBus:
+    """Barrier-backed object collectives with the dist.py contracts,
+    shared by N host threads. A host that stops participating (crash,
+    desync) breaks the barrier within ``timeout`` and every peer raises
+    instead of hanging the test suite."""
+
+    def __init__(self, n: int, timeout: float = 30.0):
+        self.n = n
+        self.timeout = timeout
+        self._barrier = threading.Barrier(n)
+        self._slots = [None] * n
+
+    def host(self, i: int) -> DecisionBus:
+        return DecisionBus(
+            num_processes=self.n,
+            process_index=i,
+            all_gather=partial(self._all_gather, i),
+            broadcast=partial(self._broadcast, i),
+        )
+
+    def _all_gather(self, rank: int, obj):
+        self._slots[rank] = obj
+        self._barrier.wait(self.timeout)
+        out = list(self._slots)
+        self._barrier.wait(self.timeout)  # slots stable until all read
+        return out
+
+    def _broadcast(self, rank: int, objs: list, src: int = 0) -> list:
+        gathered = self._all_gather(
+            rank, list(objs) if rank == src else None)
+        objs[:] = gathered[src]
+        return objs
+
+
+def run_hosts(n, fn, timeout=60.0):
+    """Run ``fn(host_index, DecisionBus)`` on N threads; returns
+    (results, errors) indexed by host."""
+    bus = FakeBus(n)
+    results, errors = [None] * n, [None] * n
+
+    def worker(i):
+        try:
+            results[i] = fn(i, bus.host(i))
+        except Exception as exc:  # noqa: BLE001 — surfaced via `errors`
+            errors[i] = exc
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+    assert not any(t.is_alive() for t in threads), \
+        "a simulated host wedged (collective desync?)"
+    return results, errors
+
+
+def make_manager(policy="skip", **sentinel_kw):
+    return ResilienceManager(
+        sentinel=DivergenceSentinel(policy=policy, **sentinel_kw),
+        injector=FaultInjector(),
+        sentinel_frequency=1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Decision protocol (CoordinatedResilience directly)
+# ---------------------------------------------------------------------------
+
+
+class TestCoordinatedDecisions:
+    def test_one_host_stop_flag_stops_everyone(self):
+        def host(i, bus):
+            mgr = make_manager()
+            mgr.preemption = PreemptionHandler()
+            if i == 2:
+                mgr.preemption.trigger()
+            coord = CoordinatedResilience(mgr, bus=bus)
+            return coord.should_stop()
+
+        results, errors = run_hosts(4, host)
+        assert errors == [None] * 4
+        assert results == [True] * 4
+
+    def test_healthy_step_ok_everywhere(self):
+        def host(i, bus):
+            coord = CoordinatedResilience(make_manager(), bus=bus)
+            m, action = coord.after_step(1, {"loss": 2.0})
+            return action, coord.manager.sentinel.ema
+
+        results, errors = run_hosts(4, host)
+        assert errors == [None] * 4
+        # identical action AND identical sentinel state fleet-wide
+        assert all(r == ("ok", 2.0) for r in results)
+
+    def test_one_host_nan_skips_fleet_wide(self):
+        def host(i, bus):
+            coord = CoordinatedResilience(make_manager(), bus=bus)
+            coord.after_step(1, {"loss": 2.0})
+            loss = float("nan") if i == 1 else 2.0
+            _, action = coord.after_step(2, {"loss": loss})
+            return action, coord.manager.sentinel.total_anomalies
+
+        results, errors = run_hosts(4, host)
+        assert errors == [None] * 4
+        # every host counts the agreed anomaly, not just the observer
+        assert all(r == ("skip", 1) for r in results)
+
+    def test_drifted_host_obeys_host0_broadcast(self):
+        # host 1's EMA has drifted (simulated partial restart): its local
+        # verdict for the same loss differs, but the broadcast wins
+        def host(i, bus):
+            mgr = make_manager(policy="skip", spike_factor=2.0)
+            mgr.sentinel.ema = 1.0 if i == 0 else 100.0
+            coord = CoordinatedResilience(mgr, bus=bus)
+            _, action = coord.after_step(5, {"loss": 3.0})
+            return action
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None] * 2
+        # host 0 sees 3.0 > 2x its EMA of 1.0 -> skip; host 1 would have
+        # said ok but must obey
+        assert results == ["skip", "skip"]
+
+    def test_abort_raises_on_every_host(self):
+        def host(i, bus):
+            coord = CoordinatedResilience(
+                make_manager(policy="abort"), bus=bus)
+            coord.after_step(1, {"loss": 1.0})
+            loss = float("nan") if i == 3 else 1.0
+            coord.after_step(2, {"loss": loss})
+
+        _, errors = run_hosts(4, host)
+        assert all(isinstance(e, TrainingDivergedError) for e in errors)
+
+    def test_partial_rollback_restore_raises_everywhere(self):
+        # 2 of 4 hosts restore, 2 do not -> params now differ across the
+        # fleet; continuing would train a franken-model, so every host
+        # must raise the identical error
+        def host(i, bus):
+            coord = CoordinatedResilience(
+                make_manager(policy="rollback"), bus=bus)
+            coord.after_step(1, {"loss": 1.0})
+            coord.after_step(2, {"loss": float("nan")},
+                             rollback=lambda: i < 2)
+
+        _, errors = run_hosts(4, host)
+        assert all(isinstance(e, TrainingDivergedError) for e in errors)
+        assert all("diverged across hosts" in str(e) for e in errors)
+
+    def test_no_rollback_anywhere_downgrades_to_skip(self):
+        def host(i, bus):
+            coord = CoordinatedResilience(
+                make_manager(policy="rollback"), bus=bus)
+            coord.after_step(1, {"loss": 1.0})
+            _, action = coord.after_step(2, {"loss": float("nan")},
+                                         rollback=lambda: False)
+            return action
+
+        results, errors = run_hosts(3, host)
+        assert errors == [None] * 3
+        assert results == ["skip"] * 3
+
+    def test_stream_position_desync_aborts_fleet_wide(self):
+        # a host-local skip of an unreadable region advanced ONE host's
+        # loader past its peers: silent mismatched-batch training must
+        # become a loud lockstep abort
+        def host(i, bus):
+            coord = CoordinatedResilience(make_manager(), bus=bus)
+            coord.after_step(1, {"loss": 2.0}, position=1)
+            coord.after_step(2, {"loss": 2.0},
+                             position=3 if i == 2 else 2)
+
+        _, errors = run_hosts(4, host)
+        assert all(isinstance(e, TrainingDivergedError) for e in errors)
+        assert all("desynced" in str(e) for e in errors)
+
+    def test_agreeing_positions_pass(self):
+        def host(i, bus):
+            coord = CoordinatedResilience(make_manager(), bus=bus)
+            _, action = coord.after_step(1, {"loss": 2.0}, position=5)
+            return action
+
+        results, errors = run_hosts(3, host)
+        assert errors == [None] * 3 and results == ["ok"] * 3
+
+    def test_verify_agreement_catches_divergent_steps(self):
+        def host(i, bus):
+            coord = CoordinatedResilience(make_manager(), bus=bus)
+            coord.verify_agreement("step", 7 if i != 1 else 8)
+
+        _, errors = run_hosts(3, host)
+        assert all(isinstance(e, TrainingDivergedError) for e in errors)
+
+    def test_single_process_passthrough(self):
+        mgr = make_manager()
+        coord = CoordinatedResilience(mgr)  # no bus, 1 process
+        assert not coord.coordinated
+        m, action = coord.after_step(1, {"loss": 2.0})
+        assert action == "ok"
+        assert coord.should_stop() is False
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: the REAL Trainer.train loop on 4 simulated hosts
+# ---------------------------------------------------------------------------
+
+
+def _multihost_toy(i, bus, tmp_path, **cfg_kw):
+    cfg = e2e_cfg(tmp_path / f"host{i}", **cfg_kw)
+    t = ToyTrainer(cfg, e2e_tokens())
+    t.coordinator = CoordinatedResilience(t.resilience, bus=bus)
+    inj = t.resilience.injector
+    inj.host_index = i
+    # route the injected SIGTERM to THIS host's handler (a real os.kill
+    # would stop every simulated host at once and prove nothing)
+    inj.deliver_signal = (
+        lambda s, r=t.resilience: r.preemption.trigger(s)
+        if r.preemption is not None else None
+    )
+    return t
+
+
+class TestMultiHostTrainer:
+    def test_one_host_sigterm_collective_emergency_save(self, tmp_path):
+        """SIGTERM on exactly one host -> every host executes the
+        emergency-checkpoint decision at the SAME step."""
+
+        def host(i, bus):
+            t = _multihost_toy(i, bus, tmp_path,
+                               ft_sigterm_at_step=3, ft_sigterm_host=2)
+            t.train()
+            t.close()
+            return (t.preempted, t.global_step,
+                    t.emergency_checkpoint_saved,
+                    t.checkpoint_manager.latest_step())
+
+        results, errors = run_hosts(4, host)
+        assert errors == [None] * 4
+        assert results == [(True, 3, True, 3)] * 4
+
+    def test_rollback_decision_identical_on_all_hosts(self, tmp_path):
+        """Anomaly observed on ONE host -> the rollback is executed by
+        every host; sentinel counters and loader skew agree fleet-wide
+        (no host acts unilaterally)."""
+
+        def host(i, bus):
+            t = _multihost_toy(
+                i, bus, tmp_path, divergence_policy="rollback",
+                ft_nan_at_step=3 if i == 1 else 0)
+            t.train()
+            t.close()
+            return (t.global_step,
+                    t.resilience.counters()["rollbacks"],
+                    t._loader_skew,
+                    t.checkpoint_manager.all_steps())
+
+        results, errors = run_hosts(4, host)
+        assert errors == [None] * 4
+        assert results == [(6, 1.0, 1, [2, 4, 6])] * 4
+
+    def test_abort_is_lockstep_and_leaves_crash_reports(self, tmp_path):
+        def host(i, bus):
+            t = _multihost_toy(
+                i, bus, tmp_path, divergence_policy="abort",
+                ft_nan_at_step=3 if i == 0 else 0)
+            try:
+                t.train()
+            finally:
+                t.close()
+
+        _, errors = run_hosts(4, host)
+        assert all(isinstance(e, TrainingDivergedError) for e in errors)
+        reports = sorted(glob.glob(
+            str(tmp_path / "host*" / "crash_reports" / "crash_report_*")))
+        assert len(reports) == 4
+        body = json.loads(open(reports[0]).read())
+        assert body["step"] == 3
+        assert body["counters"]["nonfinite_losses"] == 1.0
+        assert body["config_fingerprint"]["divergence_policy"] == "abort"
+
+    def test_rollback_agrees_before_any_host_returns_early(self, tmp_path):
+        """One host's directory listing shows no checkpoint (list-after-
+        write lag / racing retention sweep): the fleet must agree to
+        downgrade BEFORE anyone enters the restore collectives — a
+        unilateral early return would leave its peers wedged in a
+        broadcast no one answers."""
+        from scaletorch_tpu.utils.checkpoint import CheckpointManager
+
+        def host(i, bus):
+            t = _multihost_toy(i, bus, tmp_path)
+            if i == 0:
+                # seed ONLY host 0's directory, via a bus-less manager so
+                # the setup itself is not a collective
+                setup = CheckpointManager(str(tmp_path / "host0"),
+                                          async_save=False)
+                setup.save(1, params={"w": np.ones(2, np.float32)},
+                           opt_state={"m": np.zeros(2, np.float32)})
+                setup.close()
+            return t._rollback_to_last_good(2)
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None, None]
+        assert results == [False, False]  # agreed: nobody rolls back
+
+    def test_stop_flag_rides_the_step_decision(self, tmp_path):
+        """The boundary stop poll reuses the previous after_step gather
+        (one collective round per step): a SIGTERM fired before step 3's
+        decision stops every host at step 3, not later."""
+
+        def host(i, bus):
+            t = _multihost_toy(i, bus, tmp_path,
+                               ft_sigterm_at_step=3, ft_sigterm_host=0)
+            t.train()
+            t.close()
+            return t.preempted, t.global_step
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None, None]
+        assert results == [(True, 3), (True, 3)]
+
+    def test_train_has_no_single_host_preemption_gate(self):
+        from scaletorch_tpu.trainer.trainer import Trainer
+
+        src = inspect.getsource(Trainer.train)
+        assert "process_count() == 1" not in src
+
+    def test_env_overrides_route_through_registry(self, monkeypatch):
+        from scaletorch_tpu.resilience_distributed import (
+            coordinate_from_config,
+            hang_timeout_from_config,
+        )
+
+        cfg = e2e_cfg(None, ft_hang_timeout=1.0)
+        assert hang_timeout_from_config(cfg) == 1.0
+        monkeypatch.setenv("SCALETORCH_TPU_FT_HANG_TIMEOUT", "2.5")
+        assert hang_timeout_from_config(cfg) == 2.5
+        assert coordinate_from_config(cfg) is True
+        monkeypatch.setenv("SCALETORCH_TPU_FT_COORDINATE", "0")
+        assert coordinate_from_config(cfg) is False  # present-wins
+
+
+# ---------------------------------------------------------------------------
+# Coordinated checkpoint manager
+# ---------------------------------------------------------------------------
+
+
+def _tree(x=1.0):
+    return {"w": np.full((2, 3), x, dtype=np.float32)}
+
+
+def _make_cm(tmp_path, i, bus, **kw):
+    from scaletorch_tpu.utils.checkpoint import CheckpointManager
+
+    kw.setdefault("retry_base_delay", 0.01)
+    kw.setdefault("async_save", False)
+    return CheckpointManager(str(tmp_path / f"host{i}"), decision_bus=bus,
+                             **kw)
+
+
+class TestCoordinatedCheckpoints:
+    def test_one_host_failure_retried_in_lockstep(self, tmp_path):
+        def host(i, bus):
+            inj = FaultInjector(fail_saves=1 if i == 0 else 0)
+            cm = _make_cm(tmp_path, i, bus, retries=3, fault_injector=inj)
+            ok = cm.save(1, params=_tree(), opt_state=_tree())
+            cm.wait()
+            return ok, cm.all_steps()
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None] * 2
+        assert results == [(True, [1])] * 2
+
+    def test_exhausted_retries_fail_symmetrically_without_raising(
+            self, tmp_path):
+        def host(i, bus):
+            inj = FaultInjector(fail_saves=100 if i == 1 else 0)
+            cm = _make_cm(tmp_path, i, bus, retries=1, fault_injector=inj)
+            return cm.save(1, params=_tree(), opt_state=_tree())
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None] * 2
+        assert results == [False, False]
+
+    def test_mixed_saved_skipped_retries_to_convergence(self, tmp_path):
+        """One host's directory view already lists the step (orbax's
+        should_save silently no-ops -> saved=False) while its peer saves
+        (True): the agreed outcome must not diverge — the stale copy is
+        retired and the retry converges on all-saved."""
+        from scaletorch_tpu.utils.checkpoint import CheckpointManager
+
+        def host(i, bus):
+            if i == 0:  # pre-existing step 1 in host 0's view only
+                setup = CheckpointManager(str(tmp_path / "host0"),
+                                          async_save=False)
+                setup.save(1, params=_tree(0.0), opt_state=_tree())
+                setup.close()
+            cm = _make_cm(tmp_path, i, bus, retries=2)
+            ok = cm.save(1, params=_tree(), opt_state=_tree())
+            cm.wait()
+            return ok
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None] * 2
+        assert results == [True, True]
+
+    def test_corrupt_step_falls_back_fleet_wide(self, tmp_path):
+        import shutil
+
+        def host(i, bus):
+            cm = _make_cm(tmp_path, i, bus, retries=0)
+            for step in (1, 2):
+                assert cm.save(step, params=_tree(step), opt_state=_tree())
+            cm.wait()
+            if i == 0:  # corrupt ONLY host 0's newest step
+                victim = next(
+                    p for p in (tmp_path / "host0" / "2").iterdir()
+                    if "param" in p.name)
+                shutil.rmtree(victim)
+            out = cm.load_latest(params=_tree(), opt_state=_tree())
+            return out["step"] if out else None
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None] * 2
+        # host 1's step 2 restores fine locally, but the fleet must land
+        # on ONE step — the newest readable everywhere
+        assert results == [1, 1]
+
+    def test_wait_failure_degrades_every_host_to_sync(self, tmp_path):
+        def host(i, bus):
+            cm = _make_cm(tmp_path, i, bus, retries=1, async_save=True)
+            if i == 0:
+                cm._mgr.wait_until_finished = lambda: (_ for _ in ()).throw(
+                    RuntimeError("pool dead"))
+            cm.wait()
+            return cm._async
+
+        results, errors = run_hosts(2, host)
+        assert errors == [None] * 2
+        assert results == [False, False]
+
+
+# ---------------------------------------------------------------------------
+# Post-save integrity verification (opt-in)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckpointVerification:
+    def _cm(self, tmp_path, **kw):
+        from scaletorch_tpu.utils.checkpoint import CheckpointManager
+
+        kw.setdefault("retry_base_delay", 0.01)
+        return CheckpointManager(str(tmp_path), async_save=False,
+                                 verify=True, **kw)
+
+    def test_clean_save_verifies(self, tmp_path):
+        cm = self._cm(tmp_path)
+        assert cm.save(1, params=_tree(), opt_state=_tree())
+        assert cm.all_steps() == [1]
+
+    def test_metadata_mismatch_retires_the_step(self, tmp_path):
+        cm = self._cm(tmp_path)
+        # a torn write: the read-back metadata is missing the params item
+        cm._mgr.item_metadata = lambda step: type(
+            "MD", (), {"params": None, "opt_state": None})()
+        assert cm.save(1, params=_tree(), opt_state=_tree()) is False
+        assert cm.all_steps() == []  # retired via the unreadable path
+
+    def test_verify_mismatch_describes_shape_drift(self, tmp_path):
+        cm = self._cm(tmp_path)
+        assert cm.save(1, params=_tree(), opt_state=_tree())
+        other = {"w": np.zeros((4, 4), dtype=np.float32)}
+        msg = cm._verify_mismatch(1, other, _tree())
+        assert msg is not None and "shape" in msg
+        assert cm._verify_mismatch(1, _tree(), _tree()) is None
+
+
+# ---------------------------------------------------------------------------
+# Hang watchdog
+# ---------------------------------------------------------------------------
+
+
+class TestHangWatchdog:
+    def test_fires_dumps_and_exits_with_documented_code(self, tmp_path):
+        exits, reports = [], []
+
+        def report(info):
+            path = write_crash_report(
+                info["reason"], info["step"],
+                directory=str(tmp_path),
+                thread_stacks=info["thread_stacks"],
+                monitor_records=[{"step": 1, "host_cpu_percent": 1.0}],
+            )
+            reports.append(path)
+            return path
+
+        wd = HangWatchdog(timeout=0.2, poll_interval=0.05,
+                          crash_report=report, exit_fn=exits.append)
+        with wd:
+            wd.beat(1, "step_dispatch")
+            time.sleep(0.8)
+        assert wd.fired
+        assert exits == [WATCHDOG_EXIT_CODE] and WATCHDOG_EXIT_CODE == 43
+        body = json.loads(open(reports[0]).read())
+        assert "step_dispatch" in body["reason"]
+        assert body["monitor_records"]  # ring buffer rode along
+        # the stack dump names this (the main) thread and a real frame
+        assert any("MainThread" in k for k in body["thread_stacks"])
+        assert "time.sleep" in "".join(body["thread_stacks"].values()) \
+            or "test_resilience_distributed" in \
+            "".join(body["thread_stacks"].values())
+
+    def test_beats_keep_it_quiet(self):
+        exits = []
+        wd = HangWatchdog(timeout=0.3, poll_interval=0.05,
+                          exit_fn=exits.append)
+        with wd:
+            for _ in range(8):
+                time.sleep(0.07)
+                wd.beat(2, "data_fetch")
+        assert not wd.fired and exits == []
+
+    def test_rejects_nonpositive_timeout(self):
+        with pytest.raises(ValueError, match="timeout"):
+            HangWatchdog(timeout=0.0)
+
+    def test_injected_hang_trips_watchdog_end_to_end(self, tmp_path):
+        """Acceptance: a FaultInjector stall at step k trips the watchdog
+        within the configured timeout, writes a crash report containing
+        thread stacks + ring buffer, and requests the documented exit
+        code — on the REAL Trainer.train loop."""
+        cfg = e2e_cfg(tmp_path, total_train_steps=4,
+                      ft_hang_at_step=2, ft_hang_seconds=1.2,
+                      ft_hang_timeout=0.3)
+        t = ToyTrainer(cfg, e2e_tokens())
+        codes = []
+        t._watchdog_exit = codes.append  # record instead of os._exit
+        t.step()  # compile the jit step OUTSIDE the watchdog window
+        t.train()
+        t.close()
+        # the injected stall outlived the timeout -> watchdog fired with
+        # the documented code; the (recorded, not executed) exit lets the
+        # loop finish its remaining steps hermetically
+        assert codes == [WATCHDOG_EXIT_CODE]
+        assert t.global_step == 4
+        reports = glob.glob(
+            str(tmp_path / "crash_reports" / "crash_report_step2*"))
+        assert len(reports) == 1
+        body = json.loads(open(reports[0]).read())
+        assert "hang watchdog" in body["reason"]
+        assert body["thread_stacks"]
+        assert "monitor_records" in body
+        assert body["config_fingerprint"]["total_train_steps"] == 4
+
+    def test_watchdog_disarmed_after_train(self, tmp_path):
+        cfg = e2e_cfg(tmp_path, total_train_steps=2, ft_hang_timeout=5.0)
+        t = ToyTrainer(cfg, e2e_tokens())
+        t.train()
+        t.close()
+        assert t._watchdog is None  # stopped + cleared in the finally
+
+
+# ---------------------------------------------------------------------------
+# Crash reports
+# ---------------------------------------------------------------------------
+
+
+class TestCrashReports:
+    def test_writer_contract(self, tmp_path):
+        path = write_crash_report(
+            "sentinel abort", 17, directory=str(tmp_path),
+            counters={"anomalies": 2.0},
+            last_metrics=[{"step": 17, "loss": 9.9}],
+            monitor_records=[{"step": 16, "host_mem_percent": 40.0}],
+        )
+        assert path.endswith("crash_report_step17.json")
+        body = json.loads(open(path).read())
+        assert body["reason"] == "sentinel abort"
+        assert body["counters"]["anomalies"] == 2.0
+        assert body["last_metrics"][0]["loss"] == 9.9
+
+    def test_nonzero_process_gets_suffixed_file(self, tmp_path):
+        path = write_crash_report("x", 3, directory=str(tmp_path),
+                                  process_index=2)
+        assert path.endswith("crash_report_step3_proc2.json")
+
+    def test_unwritable_directory_never_raises(self):
+        assert write_crash_report(
+            "x", 1, directory="/proc/definitely/not/writable") == ""
+
+    def test_fingerprint_is_stable_and_carries_identity(self):
+        cfg = e2e_cfg(None)
+        a, b = config_fingerprint(cfg), config_fingerprint(cfg)
+        assert a == b and len(a["sha256"]) == 16
+        assert a["seed"] == cfg.seed
+
+    def test_rollback_budget_exhaustion_writes_report(self, tmp_path):
+        cfg = e2e_cfg(tmp_path, divergence_policy="rollback",
+                      max_rollbacks=1, ft_nan_at_step=3)
+        t = ToyTrainer(cfg, e2e_tokens())
+        t.train()  # rollback #1 consumes the budget
+        t.resilience.injector.nan_at_step = t.global_step + 1
+        t.resilience.injector._nan_fired = False
+        with pytest.raises(TrainingDivergedError, match="rollback"):
+            t.train(num_steps=2)
+        t.close()
+        reports = glob.glob(str(tmp_path / "crash_reports" / "*.json"))
+        assert len(reports) == 1
+        assert "rollback" in json.loads(open(reports[0]).read())["reason"]
+
+    def test_thread_stack_dump_sees_all_threads(self):
+        stacks = dump_thread_stacks()
+        assert any("MainThread" in name for name in stacks)
+
+    def test_exit_codes_documented_and_distinct(self):
+        assert DIVERGED_EXIT_CODE == 42 and WATCHDOG_EXIT_CODE == 43

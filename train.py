@@ -23,6 +23,8 @@ import sys
 
 def main(argv=None) -> int:
     from scaletorch_tpu.config import parse_args
+    from scaletorch_tpu.resilience import TrainingDivergedError
+    from scaletorch_tpu.resilience_distributed import DIVERGED_EXIT_CODE
     from scaletorch_tpu.trainer.trainer import Trainer
     from scaletorch_tpu.utils.logger import get_logger
 
@@ -56,6 +58,14 @@ def main(argv=None) -> int:
         # close()'s wait — otherwise the process could exit mid-write
         if cfg.checkpoint_dir and cfg.save_frequency:
             trainer.save_checkpoint()
+    except TrainingDivergedError as exc:
+        # the trainer already wrote results/crash_report_step<N>.json;
+        # exit with the documented code so launchers/schedulers can tell
+        # "diverged, needs a human" from "preempted, just restart"
+        # (docs/fault_tolerance.md exit-code contract; the hang watchdog
+        # exits 43 directly from its monitor thread)
+        get_logger().error(f"training aborted: {exc}")
+        return DIVERGED_EXIT_CODE
     except KeyboardInterrupt:
         get_logger().warning("interrupted; exiting")
         return 130
