@@ -23,6 +23,10 @@ Reference parity map (reference file -> this package):
   scaletorch/trainer/                   -> scaletorch_tpu.trainer
   scaletorch/data/                      -> scaletorch_tpu.data
   scaletorch/utils/                     -> scaletorch_tpu.utils
+
+Beyond the reference: ``scaletorch_tpu.inference`` — the serving half
+(KV-cache decode engine with continuous batching over the same mesh/TP
+specs; see docs/inference.md).
 """
 
 __version__ = "0.1.0"

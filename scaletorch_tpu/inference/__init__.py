@@ -1,0 +1,45 @@
+"""TPU-native inference: KV-cache decode, continuous batching, sharded serving.
+
+The serving half of the framework (ROADMAP north star: "serves heavy
+traffic from millions of users"), reusing the training stack's mesh, TP
+sharding specs, and attention math:
+
+  * ``kv_cache``  — per-layer KV caches in the models' scan layout
+    ``[L, B, Hkv, S_max, D]``, head-sharded with the existing TP
+    NamedSharding specs; plus the MLA latent-only cache.
+  * ``decode``    — the two jitted steps (full-prompt prefill, single-
+    token decode) over the models' cache-aware forwards; static shapes,
+    donated cache buffers, two compiles total.
+  * ``sampling``  — greedy / temperature / top-k / top-p with per-slot
+    PRNG keys.
+  * ``engine``    — continuous batching over a fixed-slot batch: admit
+    queued requests into freed slots between decode steps (the jitted
+    step never retraces), engine metrics riding the monitor plumbing.
+"""
+
+from scaletorch_tpu.inference.kv_cache import (  # noqa: F401
+    KVCache,
+    MLACache,
+    init_kv_cache,
+    init_mla_cache,
+    kv_cache_bytes,
+    kv_cache_shape,
+    kv_cache_shardings,
+    kv_cache_specs,
+)
+from scaletorch_tpu.inference.sampling import (  # noqa: F401
+    SamplingParams,
+    sample,
+    sample_one,
+)
+from scaletorch_tpu.inference.decode import (  # noqa: F401
+    make_decode_step,
+    make_prefill_step,
+    resolve_forward_cached,
+)
+from scaletorch_tpu.inference.engine import (  # noqa: F401
+    EngineMetrics,
+    InferenceEngine,
+    Request,
+    RequestResult,
+)

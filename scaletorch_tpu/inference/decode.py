@@ -1,0 +1,179 @@
+"""The two jitted engine steps: full-prompt prefill and one-token decode.
+
+Static shapes everywhere — the engine compiles each step exactly once
+per run, however many requests flow through it:
+
+  * ``prefill``: a full-sequence causal forward over the fixed
+    ``[B, P_max]`` prompt buffer that also writes cache positions
+    [0, P_max) for the slots named by ``write_mask`` (live slots'
+    cache bytes are untouched), returns the first sampled token per
+    slot. Admitting a request into a freed slot is "set its row of the
+    buffer, flip its mask bit" — no new trace.
+  * ``decode``: one token per slot at per-slot absolute positions,
+    RoPE at the absolute position, ``lax.dynamic_update_slice`` cache
+    append, sample. Cache buffers are DONATED — XLA appends in place
+    instead of copying the whole cache every token.
+
+Both lower onto the models' cache-aware forwards
+(models/llama.py forward_cached & family), resolved per config by
+``resolve_forward_cached``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from scaletorch_tpu.inference.kv_cache import KVCache
+from scaletorch_tpu.inference.sampling import SamplingParams, sample, slot_keys
+
+
+def _resolve_donate(donate_cache: Optional[bool]) -> bool:
+    """None = donate wherever the backend honours it (TPU/GPU); the CPU
+    runtime ignores donation and warns per call, so skip it there."""
+    if donate_cache is not None:
+        return donate_cache
+    return jax.default_backend() != "cpu"
+
+
+def resolve_forward_cached(cfg) -> Callable:
+    """The cache-aware forward for a model config: Qwen3-MoE and GPT-MoE
+    have their own cached forwards; every other LlamaConfig subclass
+    (Llama, Qwen3) shares the Llama one."""
+    from scaletorch_tpu.models.gpt_moe import GPTMoEConfig
+    from scaletorch_tpu.models.llama import LlamaConfig
+    from scaletorch_tpu.models.qwen3_moe import Qwen3MoEConfig
+
+    if isinstance(cfg, Qwen3MoEConfig):
+        from scaletorch_tpu.models import qwen3_moe
+
+        return qwen3_moe.forward_cached
+    if isinstance(cfg, LlamaConfig):
+        from scaletorch_tpu.models import llama
+
+        return llama.forward_cached
+    if isinstance(cfg, GPTMoEConfig):
+        from scaletorch_tpu.models import gpt_moe
+
+        return gpt_moe.forward_cached
+    raise TypeError(
+        f"no cache-aware forward known for config {type(cfg).__name__}"
+    )
+
+
+def make_prefill_step(
+    cfg,
+    sampling: SamplingParams,
+    *,
+    forward_fn: Optional[Callable] = None,
+    donate_cache: Optional[bool] = None,
+) -> Callable:
+    """Build the jitted prefill step.
+
+    prefill(params, tokens [B, P], lengths [B], write_mask [B] bool,
+            cache, base_keys [B, 2])
+      -> (first_token [B] i32, last_logits [B, V] f32, new_cache)
+
+    Runs the full causal forward over the whole fixed buffer (positions
+    [0, P) for every slot), writes cache [0, P) for masked slots only,
+    reads each slot's logits at ``lengths - 1`` and samples its first
+    token. Anything the buffer holds beyond a slot's length writes
+    garbage K/V above the slot's live region — invisible, because the
+    j <= p attention mask never reaches past the current position and
+    decode overwrites position p before attending to it.
+    """
+    fwd = forward_fn or resolve_forward_cached(cfg)
+
+    def prefill(params, tokens, lengths, write_mask, cache, base_keys):
+        b, p = tokens.shape
+        positions = jnp.broadcast_to(
+            jnp.arange(p, dtype=jnp.int32), (b, p))
+        logits, new_cache = fwd(
+            params, tokens, cfg, tuple(cache),
+            positions=positions, write_mask=write_mask,
+        )
+        last = jnp.take_along_axis(
+            logits, (lengths - 1)[:, None, None], axis=1
+        )[:, 0, :]
+        keys = slot_keys(base_keys, lengths - 1)
+        first = sample(last, keys, sampling)
+        return first, last.astype(jnp.float32), KVCache(*new_cache)
+
+    return jax.jit(
+        prefill, donate_argnums=(4,) if _resolve_donate(donate_cache) else ()
+    )
+
+
+def make_decode_step(
+    cfg,
+    sampling: SamplingParams,
+    *,
+    forward_fn: Optional[Callable] = None,
+    donate_cache: Optional[bool] = None,
+) -> Callable:
+    """Build the jitted single-token decode step.
+
+    decode(params, tokens [B] i32, positions [B] i32, active [B] bool,
+           cache, base_keys [B, 2])
+      -> (next_token [B] i32, logits [B, V] f32, new_cache)
+
+    Feeds each slot's current token at its absolute position (RoPE at
+    that position), appends K/V at the position for ACTIVE slots only,
+    and samples the next token with the slot's (seed, position) key.
+    Inactive slots compute garbage that goes nowhere — their mask bit
+    keeps their cache bytes intact and the engine ignores their sample.
+    """
+    fwd = forward_fn or resolve_forward_cached(cfg)
+
+    def decode(params, tokens, positions, active, cache, base_keys):
+        logits, new_cache = fwd(
+            params, tokens[:, None], cfg, tuple(cache),
+            positions=positions[:, None], write_mask=active,
+        )
+        step_logits = logits[:, 0, :]
+        keys = slot_keys(base_keys, positions)
+        nxt = sample(step_logits, keys, sampling)
+        return nxt, step_logits.astype(jnp.float32), KVCache(*new_cache)
+
+    return jax.jit(
+        decode, donate_argnums=(4,) if _resolve_donate(donate_cache) else ()
+    )
+
+
+def teacher_forced_decode(
+    params,
+    cfg,
+    tokens: jax.Array,
+    *,
+    max_seq: Optional[int] = None,
+    prefill_len: int = 1,
+    forward_fn: Optional[Callable] = None,
+    dtype=None,
+) -> jax.Array:
+    """Reference harness: prefill the first ``prefill_len`` tokens, then
+    decode the rest one at a time with the GROUND-TRUTH token at each
+    step (no sampling). Returns [B, S, V] logits position-aligned with
+    the full-sequence training forward — the parity oracle the engine
+    tests assert against (ISSUE 4 acceptance: prefill+decode logit
+    parity under teacher forcing).
+    """
+    from scaletorch_tpu.inference.kv_cache import init_kv_cache
+
+    fwd = forward_fn or resolve_forward_cached(cfg)
+    b, s = tokens.shape
+    cache = init_kv_cache(cfg, b, max_seq or s,
+                          dtype=dtype or getattr(cfg, "dtype", None))
+    p = prefill_len
+    positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+    logits_p, cache = fwd(params, tokens[:, :p], cfg, tuple(cache),
+                          positions=positions)
+    chunks = [logits_p]
+    for t in range(p, s):
+        logits_t, cache = fwd(
+            params, tokens[:, t:t + 1], cfg, tuple(cache),
+            positions=jnp.full((b, 1), t, jnp.int32),
+        )
+        chunks.append(logits_t)
+    return jnp.concatenate(chunks, axis=1)

@@ -1,0 +1,397 @@
+"""Continuous-batching inference engine over a fixed-slot batch.
+
+The serving loop the ROADMAP's "heavy traffic" story needs, shaped for
+TPU execution discipline:
+
+  * a FIXED number of slots (the decode batch) and a FIXED maximum
+    sequence length — every device buffer keeps its shape for the whole
+    engine lifetime, so the two jitted steps (prefill / decode,
+    inference/decode.py) compile exactly once each;
+  * per-slot lengths and stop state live on the HOST; between decode
+    steps the engine admits queued requests into freed slots by writing
+    their row of the prompt buffer and flipping their ``write_mask``
+    bit — data changes, shapes don't, nothing retraces;
+  * the KV cache is donated through every step (XLA appends in place);
+    with a mesh it is head-sharded over ``tp`` via the same specs the
+    training params use (kv_cache_specs), and the steps run GSPMD.
+
+Metrics ride the existing plumbing: ``EngineMetrics`` keeps the
+counters/gauges (tokens/s, time-to-first-token, queue depth, slot
+occupancy) and can sample them into a ``SystemMonitor`` ring buffer
+(utils/monitor.py) so a serving process's tail is diagnosable exactly
+like a training run's.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scaletorch_tpu.inference.decode import (
+    make_decode_step,
+    make_prefill_step,
+)
+from scaletorch_tpu.inference.kv_cache import (
+    init_kv_cache,
+    kv_cache_bytes,
+)
+from scaletorch_tpu.inference.sampling import SamplingParams
+from scaletorch_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+
+@dataclass
+class Request:
+    """One generation request. ``eos_id`` stops the slot early;
+    ``max_new_tokens`` always bounds it; the engine's ``max_seq`` caps
+    prompt + generation regardless."""
+
+    request_id: int
+    prompt: List[int]
+    max_new_tokens: int = 64
+    eos_id: Optional[int] = None
+    seed: int = 0
+    submit_time: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class RequestResult:
+    request_id: int
+    prompt: List[int]
+    tokens: List[int]               # generated tokens (prompt excluded)
+    finish_reason: str              # 'eos' | 'length' | 'max_seq'
+    ttft_s: Optional[float] = None  # submit -> first generated token
+    latency_s: Optional[float] = None
+
+
+@dataclass
+class EngineMetrics:
+    """Serving health counters/gauges. ``snapshot()`` is flat numeric —
+    ready for a MetricsLogger line or a SystemMonitor ring-buffer record
+    (``monitor.sample(counters=metrics.snapshot())``)."""
+
+    requests_submitted: int = 0
+    requests_completed: int = 0
+    tokens_generated: int = 0
+    prefill_calls: int = 0
+    decode_steps: int = 0
+    queue_depth: int = 0
+    active_slots: int = 0
+    num_slots: int = 0
+    ttft_sum_s: float = 0.0
+    ttft_count: int = 0
+    _window_start: float = field(default_factory=time.monotonic)
+    _window_tokens: int = 0
+
+    def record_ttft(self, ttft_s: float) -> None:
+        self.ttft_sum_s += ttft_s
+        self.ttft_count += 1
+
+    def tokens_per_second(self) -> float:
+        dt = time.monotonic() - self._window_start
+        return self._window_tokens / dt if dt > 0 else 0.0
+
+    def reset_window(self) -> None:
+        self._window_start = time.monotonic()
+        self._window_tokens = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "requests_submitted": self.requests_submitted,
+            "requests_completed": self.requests_completed,
+            "tokens_generated": self.tokens_generated,
+            "prefill_calls": self.prefill_calls,
+            "decode_steps": self.decode_steps,
+            "queue_depth": self.queue_depth,
+            "slot_occupancy": (
+                self.active_slots / self.num_slots if self.num_slots else 0.0
+            ),
+            "tokens_per_second": self.tokens_per_second(),
+            "mean_ttft_s": (
+                self.ttft_sum_s / self.ttft_count if self.ttft_count else 0.0
+            ),
+        }
+
+
+class _Slot:
+    """Host-side state of one decode slot."""
+
+    __slots__ = ("request", "tokens", "position", "generated", "first_token_t")
+
+    def __init__(self) -> None:
+        self.request: Optional[Request] = None
+        self.tokens: List[int] = []
+        self.position = 0        # absolute position of the NEXT token to feed
+        self.generated = 0
+        self.first_token_t: Optional[float] = None
+
+    @property
+    def active(self) -> bool:
+        return self.request is not None
+
+
+class InferenceEngine:
+    """KV-cache decode with continuous batching.
+
+    Parameters
+    ----------
+    params, cfg : the model tree and its config (any Llama-family or
+        GPT-MoE config; ``resolve_forward_cached`` picks the forward).
+        For sharded serving pass params already placed with their
+        NamedShardings (utils/hf_interop.load_hf_params(shardings=...)
+        feeds this directly).
+    max_slots : decode batch size B (fixed).
+    max_seq : cache length S_max (prompt + generation cap per slot).
+    prefill_len : static prompt-buffer length P_max (default
+        ``max_seq``); prompts longer than this are rejected.
+    sampling : engine-wide sampling knobs (static, baked into the
+        compiled steps).
+    mesh / tp_axis / batch_axis : optional — shard the cache over the
+        mesh (KV heads over ``tp_axis``, slots over ``batch_axis``).
+    monitor : optional SystemMonitor; ``step()`` samples the metrics
+        snapshot into its ring buffer every ``monitor_every`` steps.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        *,
+        max_slots: int = 4,
+        max_seq: int = 512,
+        prefill_len: Optional[int] = None,
+        sampling: SamplingParams = SamplingParams(),
+        cache_dtype: Any = None,
+        mesh: Any = None,
+        tp_axis: str = "tp",
+        batch_axis: Optional[str] = None,
+        donate_cache: Optional[bool] = None,
+        monitor: Any = None,
+        monitor_every: int = 16,
+    ) -> None:
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        if max_seq < 2:
+            raise ValueError(f"max_seq must be >= 2, got {max_seq}")
+        self.params = params
+        self.cfg = cfg
+        self.max_slots = max_slots
+        self.max_seq = max_seq
+        self.prefill_len = prefill_len or max_seq
+        if self.prefill_len > max_seq:
+            raise ValueError(
+                f"prefill_len {self.prefill_len} exceeds max_seq {max_seq}"
+            )
+        self.sampling = sampling
+        self.monitor = monitor
+        self.monitor_every = monitor_every
+
+        sharding = None
+        if mesh is not None:
+            from scaletorch_tpu.inference.kv_cache import kv_cache_shardings
+
+            sharding = kv_cache_shardings(
+                mesh, tp_axis=tp_axis, batch_axis=batch_axis)
+        self.cache = init_kv_cache(
+            cfg, max_slots, max_seq, dtype=cache_dtype, sharding=sharding)
+        logger.info(
+            "inference engine: %d slots x %d positions, cache %.1f MiB%s",
+            max_slots, max_seq,
+            kv_cache_bytes(cfg, max_slots, max_seq,
+                           dtype=cache_dtype) / 2**20,
+            f", sharded over {mesh.axis_names}" if mesh is not None else "",
+        )
+
+        self._prefill = make_prefill_step(
+            cfg, sampling, donate_cache=donate_cache)
+        self._decode = make_decode_step(
+            cfg, sampling, donate_cache=donate_cache)
+
+        self._slots = [_Slot() for _ in range(max_slots)]
+        self._queue: deque[Request] = deque()
+        self._results: Dict[int, RequestResult] = {}
+        self._ids = itertools.count()
+        self._base_keys = np.zeros((max_slots, 2), np.uint32)
+        self.metrics = EngineMetrics(num_slots=max_slots)
+
+    # ---- compile accounting (the no-retrace contract) --------------------
+    @property
+    def decode_compile_count(self) -> int:
+        return self._decode._cache_size()
+
+    @property
+    def prefill_compile_count(self) -> int:
+        return self._prefill._cache_size()
+
+    # ---- request lifecycle ----------------------------------------------
+    def submit(
+        self,
+        prompt: List[int],
+        *,
+        max_new_tokens: int = 64,
+        eos_id: Optional[int] = None,
+        seed: int = 0,
+    ) -> int:
+        """Queue a request; returns its id. Admission happens inside
+        ``step()`` when a slot frees up."""
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        if len(prompt) > self.prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the engine's static "
+                f"prefill buffer ({self.prefill_len}); re-create the engine "
+                "with a larger prefill_len/max_seq"
+            )
+        if len(prompt) >= self.max_seq:
+            raise ValueError(
+                f"prompt length {len(prompt)} leaves no room to generate "
+                f"within max_seq {self.max_seq}"
+            )
+        req = Request(
+            request_id=next(self._ids), prompt=list(prompt),
+            max_new_tokens=max_new_tokens, eos_id=eos_id, seed=seed,
+        )
+        self._queue.append(req)
+        self.metrics.requests_submitted += 1
+        self.metrics.queue_depth = len(self._queue)
+        return req.request_id
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots and prefill them — ONE
+        batched prefill call regardless of how many were admitted."""
+        free = [i for i, s in enumerate(self._slots) if not s.active]
+        if not free or not self._queue:
+            return
+        admitted: List[int] = []
+        tokens = np.zeros((self.max_slots, self.prefill_len), np.int32)
+        lengths = np.ones(self.max_slots, np.int32)
+        write_mask = np.zeros(self.max_slots, bool)
+        for i in free:
+            if not self._queue:
+                break
+            req = self._queue.popleft()
+            slot = self._slots[i]
+            slot.request = req
+            slot.tokens = list(req.prompt)
+            slot.position = len(req.prompt)
+            slot.generated = 0
+            slot.first_token_t = None
+            tokens[i, : len(req.prompt)] = req.prompt
+            lengths[i] = len(req.prompt)
+            write_mask[i] = True
+            self._base_keys[i] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32)
+            admitted.append(i)
+        first, _logits, self.cache = self._prefill(
+            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+            jnp.asarray(write_mask), self.cache, jnp.asarray(self._base_keys),
+        )
+        self.metrics.prefill_calls += 1
+        now = time.monotonic()
+        first = np.asarray(first)
+        for i in admitted:
+            slot = self._slots[i]
+            self._emit(i, int(first[i]), now)
+        self.metrics.queue_depth = len(self._queue)
+
+    def _emit(self, i: int, token: int, now: float) -> None:
+        """Record one generated token for slot i; retire the slot when a
+        stop condition hits."""
+        slot = self._slots[i]
+        req = slot.request
+        slot.tokens.append(token)
+        slot.generated += 1
+        self.metrics.tokens_generated += 1
+        self.metrics._window_tokens += 1
+        if slot.first_token_t is None:
+            slot.first_token_t = now
+            self.metrics.record_ttft(now - req.submit_time)
+
+        reason = None
+        if req.eos_id is not None and token == req.eos_id:
+            reason = "eos"
+        elif slot.generated >= req.max_new_tokens:
+            reason = "length"
+        elif slot.position + slot.generated >= self.max_seq:
+            # continuing would feed a token at position >= max_seq —
+            # past the end of the cache
+            reason = "max_seq"
+        if reason is not None:
+            self._results[req.request_id] = RequestResult(
+                request_id=req.request_id,
+                prompt=req.prompt,
+                tokens=slot.tokens[len(req.prompt):],
+                finish_reason=reason,
+                ttft_s=slot.first_token_t - req.submit_time,
+                latency_s=now - req.submit_time,
+            )
+            self.metrics.requests_completed += 1
+            slot.request = None
+            slot.tokens = []
+
+    def step(self) -> List[RequestResult]:
+        """One engine tick: admit into freed slots (prefill), then one
+        decode step for the active slots. Returns results finished this
+        tick."""
+        before = {r for r in self._results}
+        self._admit()
+        active_idx = [i for i, s in enumerate(self._slots) if s.active]
+        if active_idx:
+            tokens = np.zeros(self.max_slots, np.int32)
+            positions = np.zeros(self.max_slots, np.int32)
+            active = np.zeros(self.max_slots, bool)
+            for i in active_idx:
+                slot = self._slots[i]
+                # feed the last emitted token at its absolute position:
+                # the prompt occupies [0, len), generated token g sits at
+                # len + g - 1
+                tokens[i] = slot.tokens[-1]
+                positions[i] = slot.position + slot.generated - 1
+                active[i] = True
+            nxt, _logits, self.cache = self._decode(
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(active), self.cache,
+                jnp.asarray(self._base_keys),
+            )
+            self.metrics.decode_steps += 1
+            nxt = np.asarray(nxt)
+            now = time.monotonic()
+            for i in active_idx:
+                self._emit(i, int(nxt[i]), now)
+        self.metrics.active_slots = sum(s.active for s in self._slots)
+        self.metrics.queue_depth = len(self._queue)
+        if (
+            self.monitor is not None
+            and self.metrics.decode_steps % self.monitor_every == 0
+        ):
+            self.monitor.sample(counters=self.metrics.snapshot())
+        return [self._results[r] for r in self._results if r not in before]
+
+    @property
+    def pending(self) -> int:
+        return len(self._queue) + sum(s.active for s in self._slots)
+
+    def run(self, max_steps: int = 100_000) -> Dict[int, RequestResult]:
+        """Drive ``step()`` until queue and slots drain; returns all
+        results by request id."""
+        steps = 0
+        while self.pending and steps < max_steps:
+            self.step()
+            steps += 1
+        if self.pending:
+            raise RuntimeError(
+                f"engine did not drain within {max_steps} steps "
+                f"({self.pending} requests still in flight)"
+            )
+        return dict(self._results)
+
+    def result(self, request_id: int) -> Optional[RequestResult]:
+        return self._results.get(request_id)

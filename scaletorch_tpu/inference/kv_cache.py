@@ -1,0 +1,127 @@
+"""KV-cache containers in the models' stacked-scan layout.
+
+The decode engine keeps one cache buffer pair per model: keys and values
+``[L, B, Hkv, S_max, D]`` with the layer axis leading — the same stacked
+layout the training params use, so the cached forward scans layers and
+cache slices together (models/llama.py forward_cached) and compile time
+stays O(1) in depth.
+
+Sharding reuses the training stack's TP placement: K/V projections are
+column-parallel over ``tp`` (tensor_parallel.llama_param_specs), so the
+cache shards its KV-head axis over the same ``tp`` mesh axis —
+``kv_cache_specs`` is the cache-side counterpart of llama_param_specs.
+Slots (the engine's batch axis) can additionally shard over ``dp`` for
+throughput serving. Placement is declarative (NamedSharding +
+device_put); the jitted steps run GSPMD — no shard_map needed, so the
+serving path works on any jax new enough for NamedSharding.
+
+MLA models cache only the low-rank latent (``MLACache``,
+[B, S_max, kv_rank]) and re-expand K/V per step — the trade the variant
+documents (models/attention/variants.py MultiHeadLatentAttention).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer cache buffers, each [L, B, Hkv, S_max, D].
+
+    A NamedTuple so it is a pytree (jit/donate/scan-friendly) and
+    unpacks as the plain ``(k, v)`` pair the models' cache-aware
+    forwards consume.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+
+class MLACache(NamedTuple):
+    """Latent-only cache [B, S_max, kv_rank] for MLA attention."""
+
+    latent: jax.Array
+
+
+def kv_cache_shape(cfg, batch: int, max_seq: int) -> Tuple[int, ...]:
+    """[L, B, Hkv, S_max, D] for a Llama-family config, or
+    [L, B, H, S_max, D] for GPT-MoE (full per-head K/V)."""
+    if hasattr(cfg, "num_key_value_heads"):  # Llama / Qwen3 / Qwen3-MoE
+        return (cfg.num_hidden_layers, batch, cfg.num_key_value_heads,
+                max_seq, cfg.actual_head_dim)
+    if hasattr(cfg, "n_layer"):  # GPTMoEConfig
+        return (cfg.n_layer, batch, cfg.n_head, max_seq, cfg.head_dim)
+    raise TypeError(f"no KV-cache layout known for config {type(cfg).__name__}")
+
+
+def kv_cache_bytes(cfg, batch: int, max_seq: int, dtype: Any = None) -> int:
+    """Total cache footprint (both buffers) — the capacity-planning number
+    the engine logs at startup."""
+    shape = kv_cache_shape(cfg, batch, max_seq)
+    dt = jnp.dtype(dtype or getattr(cfg, "dtype", jnp.bfloat16))
+    n = 1
+    for d in shape:
+        n *= d
+    return 2 * n * dt.itemsize
+
+
+def init_kv_cache(
+    cfg,
+    batch: int,
+    max_seq: int,
+    *,
+    dtype: Any = None,
+    sharding: Optional[Any] = None,
+) -> KVCache:
+    """Zeroed cache in the model's compute dtype (bf16 on TPU). With
+    ``sharding`` (a NamedSharding, applied to both buffers, or a KVCache
+    of them) the buffers are created directly on their shards."""
+    shape = kv_cache_shape(cfg, batch, max_seq)
+    dt = dtype or getattr(cfg, "dtype", jnp.bfloat16)
+    k = jnp.zeros(shape, dt)
+    v = jnp.zeros(shape, dt)
+    if sharding is not None:
+        sk, sv = (sharding.k, sharding.v) if isinstance(sharding, KVCache) \
+            else (sharding, sharding)
+        k = jax.device_put(k, sk)
+        v = jax.device_put(v, sv)
+    return KVCache(k=k, v=v)
+
+
+def kv_cache_specs(
+    *, tp_axis: Optional[str] = "tp", batch_axis: Optional[str] = None
+) -> KVCache:
+    """PartitionSpec pair for the cache buffers — the cache-side
+    counterpart of ``llama_param_specs``: KV heads over ``tp`` (matching
+    the column-parallel k/v projections, so the decode matmuls never
+    re-shard), slots optionally over ``batch_axis`` (dp) for throughput
+    serving. Layer / sequence / head_dim axes stay unsharded — the
+    sequence axis is appended to in place every step.
+    """
+    spec = P(None, batch_axis, tp_axis, None, None)
+    return KVCache(k=spec, v=spec)
+
+
+def kv_cache_shardings(
+    mesh,
+    *,
+    tp_axis: Optional[str] = "tp",
+    batch_axis: Optional[str] = None,
+) -> KVCache:
+    """NamedShardings over ``mesh`` for the cache pair."""
+    specs = kv_cache_specs(tp_axis=tp_axis, batch_axis=batch_axis)
+    return KVCache(
+        k=NamedSharding(mesh, specs.k), v=NamedSharding(mesh, specs.v)
+    )
+
+
+def init_mla_cache(attn_cfg, batch: int, max_seq: int,
+                   *, dtype: Any = None) -> MLACache:
+    """Zeroed latent cache for an AttentionConfig with MLA ranks."""
+    return MLACache(latent=jnp.zeros(
+        (batch, max_seq, attn_cfg.kv_lora_rank), dtype or attn_cfg.dtype
+    ))

@@ -1,0 +1,95 @@
+"""Token sampling: greedy / temperature / top-k / top-p, per-slot keys.
+
+All knobs are STATIC (baked into the jitted decode step at engine build
+— changing them is a new engine, not a retrace hazard mid-run); the
+per-slot PRNG keys are traced, derived per (request seed, position) so a
+slot's stream is deterministic regardless of which physical slot the
+request landed in or what its neighbours sample.
+
+Filter order follows the HF convention: temperature first, then top-k,
+then top-p on the already-scaled distribution.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = jnp.finfo(jnp.float32).min
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """temperature == 0 -> greedy argmax (top_k/top_p ignored);
+    top_k == 0 and top_p == 1.0 disable their filters."""
+
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0
+
+
+def _filter_top_k(logits: jax.Array, k: int) -> jax.Array:
+    """Keep the k highest logits, mask the rest to -inf. k is static."""
+    if k <= 0 or k >= logits.shape[-1]:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, _NEG_INF, logits)
+
+
+def _filter_top_p(logits: jax.Array, p: float) -> jax.Array:
+    """Nucleus filter: keep the smallest prefix of the sorted
+    distribution whose cumulative probability reaches ``p`` (the
+    highest-probability token always survives)."""
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # exclusive cumulative < p keeps the first token unconditionally
+    keep = (cum - probs) < p
+    cutoff = jnp.min(
+        jnp.where(keep, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    return jnp.where(logits < cutoff, _NEG_INF, logits)
+
+
+def sample_one(
+    logits: jax.Array, key: jax.Array, params: SamplingParams
+) -> jax.Array:
+    """One token from one slot's [V] logits."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / params.temperature
+    scaled = _filter_top_k(scaled, params.top_k)
+    scaled = _filter_top_p(scaled, params.top_p)
+    return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+
+
+def sample(
+    logits: jax.Array, keys: jax.Array, params: SamplingParams
+) -> jax.Array:
+    """[B, V] logits + per-slot keys [B, ...] -> [B] tokens."""
+    if params.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jax.vmap(lambda l, k: sample_one(l, k, params))(logits, keys)
+
+
+def slot_keys(base_keys: jax.Array, positions: jax.Array) -> jax.Array:
+    """Per-step keys: fold each slot's position into its request seed —
+    the (seed, position) pair makes every emitted token's randomness
+    reproducible independent of slot placement or batch composition."""
+    return jax.vmap(jax.random.fold_in)(base_keys, positions)

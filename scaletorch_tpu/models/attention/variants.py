@@ -92,7 +92,11 @@ class GroupQueryAttention(AttentionVariant):
 class MultiHeadLatentAttention(AttentionVariant):
     """Low-rank latent q/kv projections (reference mla.py:9,60-66):
     x -> down-project to a small latent -> up-project to per-head q/k/v.
-    The KV cache (in inference) would store only the latent."""
+    The KV cache (in inference) stores ONLY the latent: ``init_cache`` /
+    ``prefill`` / ``decode`` keep a [B, S_max, kv_rank] buffer and
+    re-expand K/V from it per step — per-token cache cost R floats
+    instead of 2·H·D (inference/kv_cache.MLACache wraps the buffer for
+    the engine-side bookkeeping)."""
 
     def init(self, key):
         cfg = self.cfg
@@ -130,3 +134,65 @@ class MultiHeadLatentAttention(AttentionVariant):
         v = v.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
         o = sdpa_attention(q, k, v, causal=causal)
         return o.transpose(0, 2, 1, 3).reshape(b, s, nh * dh) @ params["o_proj"]
+
+    # ---- latent-only KV cache (decode engine hook) -----------------------
+
+    def init_cache(self, batch: int, max_seq: int,
+                   dtype=None) -> jax.Array:
+        """Zeroed latent cache [B, S_max, kv_rank] — the ONLY decode
+        state MLA keeps (K/V re-expand from it through k_up/v_up)."""
+        return jnp.zeros((batch, max_seq, self.cfg.kv_lora_rank),
+                         dtype or self.cfg.dtype)
+
+    def _query(self, params, x):
+        if "q_down" in params:
+            return (x @ params["q_down"]) @ params["q_up"]
+        return x @ params["q_proj"]
+
+    def _attend_cache(self, params, q, latent_cache, q_positions):
+        """q: [B, S, nh·dh] flat; latent_cache: [B, S_max, R];
+        q_positions: [B, S]. Up-projects the whole cached latent to K/V
+        and attends with the j <= p mask."""
+        from scaletorch_tpu.models.layers import cached_sdpa_attention
+
+        cfg = self.cfg
+        b, s, _ = q.shape
+        nh, dh = cfg.num_heads, cfg.actual_head_dim
+        k = (latent_cache @ params["k_up"]).reshape(b, -1, nh, dh)
+        v = (latent_cache @ params["v_up"]).reshape(b, -1, nh, dh)
+        q = q.reshape(b, s, nh, dh).transpose(0, 2, 1, 3)
+        o = cached_sdpa_attention(
+            q, k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3), q_positions
+        )
+        return o.transpose(0, 2, 1, 3).reshape(b, s, nh * dh) @ params["o_proj"]
+
+    def prefill(self, params, x, cache):
+        """Full-prompt pass that also fills the latent cache.
+
+        x: [B, P, E]; cache: [B, S_max, R] (zeroed or being reused).
+        Returns (out [B, P, E], new_cache) — ``out`` matches
+        ``__call__(params, x)`` to float tolerance.
+        """
+        b, p, _ = x.shape
+        latent = x @ params["kv_down"]  # [B, P, R]
+        cache = jax.lax.dynamic_update_slice(
+            cache, latent.astype(cache.dtype), (0, 0, 0))
+        positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+        return self._attend_cache(
+            params, self._query(params, x), cache, positions), cache
+
+    def decode(self, params, x_t, cache, positions):
+        """One decode step. x_t: [B, 1, E] (the new token's hidden);
+        positions: [B] absolute position per slot. Appends the token's
+        latent at ``positions`` and attends the query against the cached
+        latents [0, p]. Returns (out [B, 1, E], new_cache)."""
+        latent_t = x_t @ params["kv_down"]  # [B, 1, R]
+
+        def write(c, l, p):
+            return jax.lax.dynamic_update_slice(c, l, (p, 0))
+
+        cache = jax.vmap(write)(cache, latent_t.astype(cache.dtype),
+                                positions.astype(jnp.int32))
+        return self._attend_cache(
+            params, self._query(params, x_t), cache,
+            positions.astype(jnp.int32)[:, None]), cache

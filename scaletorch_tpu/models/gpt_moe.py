@@ -23,7 +23,12 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from scaletorch_tpu.models.layers import normal_init, sdpa_attention
+from scaletorch_tpu.models.layers import (
+    cached_sdpa_attention,
+    normal_init,
+    sdpa_attention,
+    write_kv_cache,
+)
 from scaletorch_tpu.parallel.expert_parallel import (
     combine_routed,
     dispatch_routed,
@@ -208,6 +213,67 @@ def forward(
     return logits
 
 
+def init_cache(
+    cfg: GPTMoEConfig, batch: int, dtype: Any = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Zeroed per-layer KV cache in the scan layout
+    [L, B, n_head, block_size, head_dim] (GPT attends with full per-head
+    K/V — no GQA grouping)."""
+    shape = (cfg.n_layer, batch, cfg.n_head, cfg.block_size, cfg.head_dim)
+    dt = dtype or cfg.dtype
+    return jnp.zeros(shape, dt), jnp.zeros(shape, dt)
+
+
+def forward_cached(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: GPTMoEConfig,
+    cache: Tuple[jax.Array, jax.Array],
+    *,
+    positions: jax.Array,
+    write_mask: Optional[jax.Array] = None,
+):
+    """KV-cached forward: [B, S] tokens at absolute ``positions`` [B, S]
+    -> (logits [B, S, V], new cache). Positional signal is the learned
+    ``wpe`` table looked up at the absolute positions (no RoPE). Routing
+    is deterministic (no noise) — matching ``generate``'s eval-mode
+    forward.
+    """
+    cache_k, cache_v = cache
+    b, s = input_ids.shape
+    cdt = cfg.dtype
+    x = (params["wte"][input_ids] + params["wpe"][positions]).astype(cdt)
+
+    def layer_body(h, xs):
+        layer, ck, cv = xs
+        a = _layer_norm(h, layer["ln1"])
+        qkv = a @ layer["attn_qkv"].astype(cdt)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(b, s, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
+
+        ck = write_kv_cache(ck, heads(k), positions[:, 0], write_mask)
+        cv = write_kv_cache(cv, heads(v), positions[:, 0], write_mask)
+        o = cached_sdpa_attention(heads(q), ck, cv, positions)
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_embd)
+        h = h + o @ layer["attn_proj"].astype(cdt)
+
+        m = _layer_norm(h, layer["ln2"])
+        if cfg.use_moe:
+            y, _ = _moe_ffn(m, layer, cfg, None, None)
+        else:
+            y = jax.nn.gelu(m @ layer["mlp_fc"].astype(cdt))
+            y = y @ layer["mlp_proj"].astype(cdt)
+        return h + y.astype(cdt), (ck, cv)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache_k, cache_v)
+    )
+    x = _layer_norm(x, params["ln_f"])
+    return x @ params["wte"].astype(cdt).T, (k_new, v_new)
+
+
 def generate(
     params: Params,
     prompt: jax.Array,
@@ -217,10 +283,82 @@ def generate(
     temperature: float = 1.0,
     key: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Autoregressive sampling (reference GPT.generate, moe.py:659-871).
+    """Autoregressive sampling (reference GPT.generate, moe.py:659-871),
+    KV-cached: one full prefill over the prompt, then a ``lax.scan`` of
+    single-token decode steps against the cache — O(S·S_max) attention
+    per emitted token instead of the old recompute path's O(S_max²·L)
+    full forward per token (retained as ``generate_recompute`` for the
+    tools/bench_decode.py A/B). Static shapes throughout — prefill + one
+    decode-scan compile. prompt: [B, P]. Greedy when temperature == 0.
 
-    TPU-style: a ``lax.scan`` over a fixed [B, block_size] buffer — static
-    shapes, one compile. prompt: [B, P]. Greedy when temperature == 0.
+    Sampled continuations draw per-step keys from ``key`` exactly like
+    before, but the stream is indexed from the prompt boundary — numeric
+    parity with the recompute path holds for greedy decoding (same math,
+    float-tolerance logits), not for the sampled RNG stream.
+    """
+    b, p = prompt.shape
+    total = min(cfg.block_size, p + max_new_tokens)
+    key = key if key is not None else jax.random.PRNGKey(0)
+    cache = init_cache(cfg, b)
+
+    buf = jnp.zeros((b, cfg.block_size), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt.astype(jnp.int32), (0, 0))
+
+    def pick(logits_t, sub):
+        if temperature == 0:
+            return jnp.argmax(logits_t, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            sub, logits_t / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    # Prefill: one causal pass over the prompt writes cache [0, p) and
+    # yields the logits that sample token p.
+    positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+    logits, cache = forward_cached(params, prompt.astype(jnp.int32), cfg,
+                                   cache, positions=positions)
+    key, sub = jax.random.split(key)
+    tok = pick(logits[:, -1, :], sub)
+    if p < total:
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, tok[:, None], p, axis=1)
+
+    def step(carry, t):
+        buf, cache, key, tok = carry
+        # feed the token at position t; its logits sample position t+1
+        logits_t, cache = forward_cached(
+            params, tok[:, None], cfg, cache,
+            positions=jnp.broadcast_to(t, (b, 1)).astype(jnp.int32),
+        )
+        key, sub = jax.random.split(key)
+        nxt = pick(logits_t[:, 0, :], sub)
+        write = t + 1 < total
+        col = jnp.where(write, nxt, buf[:, t + 1])
+        buf = jax.lax.dynamic_update_slice_in_dim(buf, col[:, None], t + 1,
+                                                  axis=1)
+        return (buf, cache, key, jnp.where(write, nxt, tok)), None
+
+    # total is a static Python int, so the scan length is exactly the
+    # requested generation — no decode steps are spent on positions the
+    # caller never asked for.
+    if p < total - 1:
+        (buf, _, _, _), _ = jax.lax.scan(
+            step, (buf, cache, key, tok),
+            jnp.arange(p, total - 1, dtype=jnp.int32),
+        )
+    return buf[:, :total]
+
+
+def generate_recompute(
+    params: Params,
+    prompt: jax.Array,
+    cfg: GPTMoEConfig,
+    *,
+    max_new_tokens: int = 32,
+    temperature: float = 1.0,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """The original cache-less sampler: reruns the full O(S²·L) forward
+    over the whole block buffer for every emitted token. Kept ONLY as the
+    baseline arm of ``tools/bench_decode.py`` — use ``generate``.
     """
     b, p = prompt.shape
     total = min(cfg.block_size, p + max_new_tokens)

@@ -119,9 +119,16 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
     custom VJP must return cotangents typed exactly like its primal
     inputs, so both are aligned to their vma union here, OUTSIDE the VJP
     — the pvary's psum transpose is then autodiff's job, not ours.
+
+    On jax builds without the VMA machinery (``jax.typeof``/``pvary``
+    absent), the alignment is a no-op — single-device and GSPMD-jit
+    semantics are unchanged.
     """
-    vma_x = frozenset(getattr(jax.typeof(x), "vma", frozenset()))
-    vma_w = frozenset(getattr(jax.typeof(weight), "vma", frozenset()))
+    try:
+        vma_x = frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+        vma_w = frozenset(getattr(jax.typeof(weight), "vma", frozenset()))
+    except AttributeError:  # jax without typeof/vma (pre-0.6)
+        return _rms_norm_p(float(eps), x, weight)
     if vma_x != vma_w:
         x = jax.lax.pvary(x, tuple(vma_w - vma_x))
         weight = jax.lax.pvary(weight, tuple(vma_x - vma_w))
@@ -141,7 +148,9 @@ def get_cos_sin(
     Matches the HF/reference convention (attention_utils.py:170-210): inverse
     frequencies over even dims, angles duplicated across the two halves.
     ``positions`` overrides 0..seq_len-1 (used by CP to slice this rank's
-    sequence shard, reference context_parallel.py:427-473).
+    sequence shard, reference context_parallel.py:427-473). A 2-D
+    ``positions`` [B, S] yields per-batch tables ``[B, S, head_dim]`` —
+    the decode path's per-slot absolute positions (inference/decode.py).
     """
     inv_freq = 1.0 / (
         rope_theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
@@ -150,8 +159,8 @@ def get_cos_sin(
         positions = jnp.arange(seq_len, dtype=jnp.float32)
     else:
         positions = positions.astype(jnp.float32)
-    freqs = jnp.outer(positions, inv_freq)  # [S, Dh/2]
-    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [S, Dh]
+    freqs = positions[..., None] * inv_freq  # [..., S, Dh/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [..., S, Dh]
     return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
 
 
@@ -163,9 +172,15 @@ def rotate_half(x: jax.Array) -> jax.Array:
 def apply_rotary_pos_emb(
     q: jax.Array, k: jax.Array, cos: jax.Array, sin: jax.Array
 ) -> Tuple[jax.Array, jax.Array]:
-    """Apply RoPE. q/k: [B, H, S, Dh]; cos/sin: [S, Dh] (broadcast over B, H)."""
-    cos = cos[None, None, :, :].astype(q.dtype)
-    sin = sin[None, None, :, :].astype(q.dtype)
+    """Apply RoPE. q/k: [B, H, S, Dh]; cos/sin: [S, Dh] (broadcast over
+    B, H) or per-batch [B, S, Dh] (decode's per-slot positions; broadcast
+    over H only)."""
+    if cos.ndim == 3:
+        cos = cos[:, None, :, :].astype(q.dtype)
+        sin = sin[:, None, :, :].astype(q.dtype)
+    else:
+        cos = cos[None, None, :, :].astype(q.dtype)
+        sin = sin[None, None, :, :].astype(q.dtype)
     q_rot = q * cos + rotate_half(q) * sin
     k_rot = k * cos + rotate_half(k) * sin
     return q_rot, k_rot
@@ -245,6 +260,63 @@ def sdpa_attention_with_lse(
     probs = jnp.where(jnp.isfinite(lse)[..., None], probs, 0.0).astype(q.dtype)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return out, lse
+
+
+def cached_sdpa_attention(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    q_positions: jax.Array,
+    *,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """SDPA against a fixed-size KV cache with absolute-position masking.
+
+    q: [B, Hq, S, D] (S = prompt length at prefill, 1 at decode);
+    k_cache/v_cache: [B, Hkv, S_max, D]; q_positions: [B, S] absolute
+    token positions. Query at position p attends cache entries j <= p —
+    causal over the cache, independent of how much of it is stale, which
+    is exactly right under the engine invariant that positions [0, p] of
+    a live slot have always been written (prefill fills [0, len), decode
+    overwrites position p before reading it).
+
+    Same fp32-softmax math as ``sdpa_attention``, so prefill logits match
+    the full-sequence training forward to float tolerance.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n_rep = q.shape[1] // k_cache.shape[1]
+    k = repeat_kv(k_cache, n_rep)
+    v = repeat_kv(v_cache, n_rep)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    key_idx = jnp.arange(k_cache.shape[2], dtype=jnp.int32)
+    mask = key_idx[None, None, :] <= q_positions[:, :, None]  # [B, S, S_max]
+    scores = jnp.where(mask[:, None], scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def write_kv_cache(
+    cache: jax.Array,
+    new: jax.Array,
+    starts: jax.Array,
+    write_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Append ``new`` [B, H, S, D] into ``cache`` [B, H, S_max, D] at
+    per-slot sequence offsets ``starts`` [B] (``lax.dynamic_update_slice``
+    vmapped over the slot axis — XLA lowers the batched variant to an
+    in-place scatter under buffer donation). ``write_mask`` [B] bool
+    keeps unlisted slots' cache bytes untouched (continuous batching
+    admits new requests without perturbing live ones)."""
+
+    def one(c, n, st):
+        return jax.lax.dynamic_update_slice(c, n, (0, st, 0))
+
+    updated = jax.vmap(one)(cache, new.astype(cache.dtype),
+                            starts.astype(jnp.int32))
+    if write_mask is not None:
+        updated = jnp.where(write_mask[:, None, None, None], updated, cache)
+    return updated
 
 
 # ---- losses -----------------------------------------------------------------

@@ -34,11 +34,13 @@ from jax.ad_checkpoint import checkpoint_name
 
 from scaletorch_tpu.models.layers import (
     apply_rotary_pos_emb,
+    cached_sdpa_attention,
     fan_in_uniform,
     get_cos_sin,
     rms_norm,
     sdpa_attention,
     swiglu,
+    write_kv_cache,
 )
 from scaletorch_tpu.models.registry import (
     get_attention_backend,
@@ -489,6 +491,115 @@ def lm_head_weight(
         else params["lm_head"].astype(cfg.dtype)
     )
     return pvary_missing(head, tp_axis) if tp_axis else head
+
+
+# ---- KV-cache inference path (scaletorch_tpu/inference) ---------------------
+#
+# The decode engine's two jitted steps (prefill / single-token decode,
+# inference/decode.py) both lower onto ``forward_cached``: a full-sequence
+# call with positions [B, 0..P) is prefill, a one-token call with positions
+# [B, 1] = p is decode. TP runs via GSPMD — params and cache arrive as
+# NamedSharding-placed global arrays (llama_param_specs + kv_cache_specs)
+# and XLA partitions the plain einsums; no shard_map/tp_axis threading.
+
+
+def attention_block_cached(
+    x: jax.Array,
+    layer: Params,
+    cache_k: jax.Array,
+    cache_v: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    write_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Cache-aware pre-norm attention sub-block with residual.
+
+    x: [B, S, H]; cache_k/cache_v: [B, Hkv, S_max, D]; cos/sin:
+    [B, S, Dh] per-slot RoPE tables; positions: [B, S] absolute token
+    positions (contiguous per slot — prefill passes [0..S), decode a
+    single column p). K/V are computed with RoPE at the absolute
+    positions, appended into the cache at ``positions[:, 0]`` (see
+    ``write_kv_cache``; ``write_mask`` [B] protects live slots during a
+    mixed admit-prefill), and attention runs q-against-cache with the
+    j <= p mask. Returns (out, new_cache_k, new_cache_v).
+    """
+    cdt = cfg.dtype
+    dh = cfg.actual_head_dim
+    h = rms_norm(x, layer["input_layernorm"], cfg.rms_norm_eps)
+    b, s, _ = h.shape
+    q = (h @ layer["q_proj"].astype(cdt)).reshape(b, s, -1, dh)
+    k = (h @ layer["k_proj"].astype(cdt)).reshape(b, s, -1, dh)
+    v = (h @ layer["v_proj"].astype(cdt)).reshape(b, s, -1, dh)
+    if cfg.qk_norm:
+        q = rms_norm(q, layer["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, layer["k_norm"], cfg.rms_norm_eps)
+    q = q.transpose(0, 2, 1, 3)  # [B, Hq, S, D]
+    k = k.transpose(0, 2, 1, 3)
+    v = v.transpose(0, 2, 1, 3)
+    q, k = apply_rotary_pos_emb(q, k, cos, sin)
+    cache_k = write_kv_cache(cache_k, k, positions[:, 0], write_mask)
+    cache_v = write_kv_cache(cache_v, v, positions[:, 0], write_mask)
+    attn = cached_sdpa_attention(q, cache_k, cache_v, positions)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
+    return x + attn @ layer["o_proj"].astype(cdt), cache_k, cache_v
+
+
+def _mlp_block(x: jax.Array, layer: Params, cfg: LlamaConfig) -> jax.Array:
+    """Dense SwiGLU MLP sub-block with residual (single-device form; the
+    TP/SP training path stays in ``_decoder_layer``)."""
+    cdt = cfg.dtype
+    h = rms_norm(x, layer["post_attention_layernorm"], cfg.rms_norm_eps)
+    gate = h @ layer["gate_proj"].astype(cdt)
+    up = h @ layer["up_proj"].astype(cdt)
+    return x + swiglu(gate, up) @ layer["down_proj"].astype(cdt)
+
+
+def forward_cached(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: LlamaConfig,
+    cache: Tuple[jax.Array, jax.Array],
+    *,
+    positions: jax.Array,
+    write_mask: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """KV-cached decoder forward: [B, S] tokens at absolute ``positions``
+    [B, S] -> (logits [B, S, V], new (cache_k, cache_v)).
+
+    ``cache`` is a pair of [L, B, Hkv, S_max, D] stacked per-layer
+    buffers in the models' scan layout (inference/kv_cache.py builds and
+    shards them). One trace serves both engine steps: prefill (S = P,
+    positions [0..P), ``write_mask`` selecting the admitted slots) and
+    decode (S = 1, positions = current length per slot). The layer loop
+    is the same ``lax.scan`` shape as the training forward — the cache
+    rides the scan as per-layer xs/ys — so compile time stays O(1) in
+    depth.
+    """
+    cache_k, cache_v = cache
+    x = embed(params, input_ids, cfg)
+    cos, sin = get_cos_sin(
+        input_ids.shape[1], cfg.actual_head_dim, cfg.rope_theta,
+        positions=positions,
+    )
+
+    def layer_body(h, xs):
+        layer, ck, cv = xs
+        h, ck, cv = attention_block_cached(
+            h, layer, ck, cv, cos, sin, positions, cfg,
+            write_mask=write_mask,
+        )
+        h = _mlp_block(h, layer, cfg)
+        return h, (ck, cv)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache_k, cache_v)
+    )
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    logits = x @ lm_head_weight(params, cfg)
+    return logits, (k_new, v_new)
 
 
 class Llama:

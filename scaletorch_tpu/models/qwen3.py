@@ -41,5 +41,12 @@ def forward(params: Params, input_ids: jax.Array, cfg: Qwen3Config, **kw):
     return _llama.forward(params, input_ids, cfg, **kw)
 
 
+def forward_cached(params: Params, input_ids: jax.Array, cfg: Qwen3Config,
+                   cache, **kw):
+    """KV-cached forward (llama.forward_cached; qk_norm rides the config
+    flag) — the decode-engine entry point for the Qwen3 family."""
+    return _llama.forward_cached(params, input_ids, cfg, cache, **kw)
+
+
 class Qwen3(_llama.Llama):
     config_cls = Qwen3Config

@@ -565,6 +565,62 @@ def lm_head_weight(params: Params, cfg: Qwen3MoEConfig,
     return _llama.lm_head_weight(params, cfg, tp_axis)
 
 
+def forward_cached(
+    params: Params,
+    input_ids: jax.Array,
+    cfg: Qwen3MoEConfig,
+    cache,
+    *,
+    positions: jax.Array,
+    write_mask: Optional[jax.Array] = None,
+):
+    """KV-cached MoE decoder forward for the decode engine
+    (inference/decode.py): [B, S] tokens at absolute ``positions`` [B, S]
+    -> (logits [B, S, V], new (cache_k, cache_v)).
+
+    Attention is the shared cache-aware Llama block; the MoE FFN is
+    stateless, so it runs the standard capacity-based dispatch per call
+    (a decode step routes one token per slot — capacity 1, never
+    dropped). Routing at decode considers each token alone, so configs
+    that DROP tokens in full-sequence routing (capacity < S·k/E worst
+    case) can emit slightly different logits at decode than teacher
+    forcing; with a dropless capacity_factor (>= E/top_k) prefill and
+    decode match the training forward exactly. Uniform-sparse layouts
+    only — interleaved dense/sparse configs have per-kind layer stacks
+    that do not align with one scanned cache.
+    """
+    if not cfg.is_uniform_sparse:
+        raise NotImplementedError(
+            "forward_cached supports uniform-sparse Qwen3-MoE configs; "
+            f"this one interleaves dense layers {cfg.dense_layer_ids()} "
+            "(mlp_only_layers/decoder_sparse_step) — serve it with the "
+            "dense Qwen3 family or extend the cache to per-kind stacks"
+        )
+    cache_k, cache_v = cache
+    x = _llama.embed(params, input_ids, cfg)
+    cos, sin = get_cos_sin(
+        input_ids.shape[1], cfg.actual_head_dim, cfg.rope_theta,
+        positions=positions,
+    )
+    helpers = _llama.tp_region_helpers(cfg, None, False)
+
+    def layer_body(h, xs):
+        layer, ck, cv = xs
+        h, ck, cv = _llama.attention_block_cached(
+            h, layer, ck, cv, cos, sin, positions, cfg,
+            write_mask=write_mask,
+        )
+        h, _aux, _stats = moe_block(h, layer, cfg, helpers)
+        return h, (ck, cv)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        layer_body, x, (params["layers"], cache_k, cache_v)
+    )
+    x = rms_norm(x, params["norm"], cfg.rms_norm_eps)
+    logits = x @ _llama.lm_head_weight(params, cfg)
+    return logits, (k_new, v_new)
+
+
 def qwen3_moe_param_specs(
     cfg: Qwen3MoEConfig,
     *,

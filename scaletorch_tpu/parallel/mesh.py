@@ -91,23 +91,32 @@ class MeshManager:
         # Axis type Auto = GSPMD sharding propagation decides unannotated
         # intermediates (jax 0.9 defaults to Explicit, which demands
         # per-op out_shardings — the wrong default for a framework whose
-        # manual-collective paths live inside shard_map anyway).
-        axis_types = (jax.sharding.AxisType.Auto,) * len(MESH_AXES)
+        # manual-collective paths live inside shard_map anyway). Older jax
+        # builds (pre-AxisType) only have Auto semantics — same behaviour,
+        # no annotation needed.
+        axis_type_cls = getattr(jax.sharding, "AxisType", None)
+        axis_types = (
+            (axis_type_cls.Auto,) * len(MESH_AXES) if axis_type_cls else None
+        )
         if devices is None:
             # Let JAX pick an ICI-friendly assignment of logical mesh axes to
             # the physical torus (this may reorder devices relative to
             # jax.devices() enumeration — see module docstring).
-            self._mesh = jax.make_mesh(self.shape, MESH_AXES, axis_types)
+            if axis_types is not None:
+                self._mesh = jax.make_mesh(self.shape, MESH_AXES, axis_types)
+            else:
+                self._mesh = jax.make_mesh(self.shape, MESH_AXES)
         else:
             # Explicit device list: caller controls placement; honour their
             # order exactly (used by tests and multi-process setups that
             # pre-arrange devices).
             import numpy as np
 
+            mesh_kw = {"axis_types": axis_types} if axis_types else {}
             self._mesh = Mesh(
                 np.asarray(self._devices).reshape(self.shape),
                 MESH_AXES,
-                axis_types=axis_types,
+                **mesh_kw,
             )
 
     # ---- sizes --------------------------------------------------------------

@@ -1,0 +1,151 @@
+"""Prefill+decode logit parity with the full-sequence training forward.
+
+ISSUE 4 acceptance: under teacher forcing, the cached decode path must
+reproduce the training forward's logits (float tolerance, fp32 compute)
+for llama (GQA), qwen3 (qk-norm + tied embeddings), and qwen3-moe
+(capacity-routed experts at a dropless capacity factor), plus the MLA
+latent-only cache at the attention-variant level. All CPU, quick tier.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaletorch_tpu.inference.decode import teacher_forced_decode
+from scaletorch_tpu.models import gpt_moe, llama, qwen3, qwen3_moe
+from scaletorch_tpu.models.attention import (
+    AttentionConfig,
+    MultiHeadLatentAttention,
+)
+
+ATOL = 2e-5  # fp32 compute: reassociation across the two attention forms
+
+TINY = dict(
+    vocab_size=64, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+    dtype=jnp.float32,
+)
+
+
+def _ids(key, b, s, v):
+    return jax.random.randint(jax.random.PRNGKey(key), (b, s), 0, v)
+
+
+class TestLlamaFamilyParity:
+    def _check(self, cfg, fwd, init, seed=0, prefill_len=5):
+        params = init(jax.random.PRNGKey(seed), cfg)
+        ids = _ids(1, 2, 12, cfg.vocab_size)
+        full = np.asarray(fwd(params, ids, cfg))
+        dec = np.asarray(teacher_forced_decode(
+            params, cfg, ids, max_seq=16, prefill_len=prefill_len))
+        np.testing.assert_allclose(dec, full, atol=ATOL)
+
+    def test_llama_gqa(self):
+        # GQA config: 4 query heads over 2 KV heads
+        cfg = llama.LlamaConfig(**TINY)
+        assert cfg.num_key_value_heads < cfg.num_attention_heads
+        self._check(cfg, llama.forward, llama.init_params)
+
+    def test_llama_mha(self):
+        cfg = llama.LlamaConfig(**{**TINY, "num_key_value_heads": 4})
+        self._check(cfg, llama.forward, llama.init_params)
+
+    def test_qwen3_qk_norm_tied(self):
+        cfg = qwen3.Qwen3Config(**{**TINY, "head_dim": 16})
+        assert cfg.qk_norm and cfg.tie_word_embeddings
+        self._check(cfg, qwen3.forward, qwen3.init_params)
+
+    def test_qwen3_moe_dropless(self):
+        # capacity_factor = E / top_k makes capacity == S: no token is
+        # ever dropped, so per-token decode routing computes exactly what
+        # full-sequence routing computes
+        cfg = qwen3_moe.Qwen3MoEConfig(
+            **{**TINY, "head_dim": 16}, moe_intermediate_size=48,
+            num_experts=4, num_experts_per_tok=2, capacity_factor=2.0,
+            tie_word_embeddings=False,
+        )
+        self._check(cfg, qwen3_moe.forward, qwen3_moe.init_params)
+
+    def test_prefill_only_matches_forward(self):
+        """Prefill over the whole sequence (no decode steps) is already
+        the training forward writing a cache on the side."""
+        cfg = llama.LlamaConfig(**TINY)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        ids = _ids(2, 2, 10, cfg.vocab_size)
+        full = np.asarray(llama.forward(params, ids, cfg))
+        dec = np.asarray(teacher_forced_decode(
+            params, cfg, ids, max_seq=10, prefill_len=10))
+        np.testing.assert_allclose(dec, full, atol=ATOL)
+
+    def test_moe_interleaved_config_rejected(self):
+        cfg = qwen3_moe.Qwen3MoEConfig(
+            **{**TINY, "head_dim": 16, "num_hidden_layers": 4},
+            moe_intermediate_size=48, num_experts=4, num_experts_per_tok=2,
+            mlp_only_layers=(0,), tie_word_embeddings=False,
+        )
+        params = qwen3_moe.init_params(jax.random.PRNGKey(0), cfg)
+        from scaletorch_tpu.inference.kv_cache import init_kv_cache
+
+        cache = init_kv_cache(cfg, 1, 8)
+        with pytest.raises(NotImplementedError, match="uniform-sparse"):
+            qwen3_moe.forward_cached(
+                params, jnp.zeros((1, 2), jnp.int32), cfg, tuple(cache),
+                positions=jnp.zeros((1, 2), jnp.int32),
+            )
+
+
+class TestMLALatentCacheParity:
+    @pytest.mark.parametrize("q_lora_rank", [None, 16])
+    def test_latent_cache_decode_matches_full(self, q_lora_rank):
+        cfg = AttentionConfig(embed_dim=64, num_heads=8, kv_lora_rank=16,
+                              q_lora_rank=q_lora_rank)
+        attn = MultiHeadLatentAttention(cfg)
+        params = attn.init(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 10, 64))
+        full = np.asarray(attn(params, x))
+
+        cache = attn.init_cache(2, 12)
+        assert cache.shape == (2, 12, 16)  # latent rank, not 2·H·D
+        out, cache = attn.prefill(params, x[:, :4], cache)
+        outs = [out]
+        for t in range(4, 10):
+            o, cache = attn.decode(params, x[:, t:t + 1], cache,
+                                   jnp.full((2,), t))
+            outs.append(o)
+        dec = np.asarray(jnp.concatenate(outs, axis=1))
+        np.testing.assert_allclose(dec, full, atol=ATOL)
+
+    def test_latent_cache_is_smaller_than_kv(self):
+        cfg = AttentionConfig(embed_dim=64, num_heads=8, kv_lora_rank=16)
+        attn = MultiHeadLatentAttention(cfg)
+        latent = attn.init_cache(1, 8)
+        kv_floats = 2 * 8 * 8 * 8  # 2 buffers · heads · seq · head_dim
+        assert latent.size < kv_floats
+
+
+class TestGptMoeGenerate:
+    CFG = gpt_moe.GPTMoEConfig(
+        block_size=32, vocab_size=65, n_layer=2, n_head=4, n_embd=64,
+        num_experts=4, top_k=2, capacity_factor=4.0,
+    )
+
+    def test_cached_greedy_matches_recompute(self):
+        """The retired recompute loop and the KV-cached generate emit the
+        same greedy continuation (same math, float-tolerance logits)."""
+        params = gpt_moe.init_params(jax.random.PRNGKey(0), self.CFG)
+        prompt = jnp.array([[1, 2, 3], [9, 8, 7]], jnp.int32)
+        cached = gpt_moe.generate(params, prompt, self.CFG,
+                                  max_new_tokens=8, temperature=0.0)
+        recomp = gpt_moe.generate_recompute(params, prompt, self.CFG,
+                                            max_new_tokens=8, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(cached), np.asarray(recomp))
+
+    def test_cached_forward_parity_with_forward(self):
+        params = gpt_moe.init_params(jax.random.PRNGKey(0), self.CFG)
+        ids = _ids(3, 2, 12, self.CFG.vocab_size)
+        full = np.asarray(gpt_moe.forward(params, ids, self.CFG))
+        dec = np.asarray(teacher_forced_decode(
+            params, self.CFG, ids, max_seq=self.CFG.block_size,
+            prefill_len=5))
+        np.testing.assert_allclose(dec, full, atol=ATOL)

@@ -1,0 +1,95 @@
+"""Sampling knobs: greedy / temperature / top-k / top-p, per-slot keys."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scaletorch_tpu.inference.sampling import (
+    SamplingParams,
+    _filter_top_k,
+    _filter_top_p,
+    sample,
+    sample_one,
+    slot_keys,
+)
+
+
+class TestParams:
+    def test_validation(self):
+        with pytest.raises(ValueError, match="temperature"):
+            SamplingParams(temperature=-1.0)
+        with pytest.raises(ValueError, match="top_k"):
+            SamplingParams(top_k=-2)
+        with pytest.raises(ValueError, match="top_p"):
+            SamplingParams(top_p=0.0)
+        with pytest.raises(ValueError, match="top_p"):
+            SamplingParams(top_p=1.5)
+
+    def test_greedy_flag(self):
+        assert SamplingParams(temperature=0.0).greedy
+        assert not SamplingParams(temperature=0.7).greedy
+
+
+class TestFilters:
+    LOGITS = jnp.array([1.0, 3.0, 2.0, -1.0])
+
+    def test_top_k_keeps_k_highest(self):
+        out = np.asarray(_filter_top_k(self.LOGITS, 2))
+        assert np.isfinite(out[[1, 2]]).all()
+        assert (out[[0, 3]] < -1e30).all()
+
+    def test_top_k_disabled(self):
+        np.testing.assert_array_equal(
+            np.asarray(_filter_top_k(self.LOGITS, 0)), np.asarray(self.LOGITS))
+        np.testing.assert_array_equal(
+            np.asarray(_filter_top_k(self.LOGITS, 10)), np.asarray(self.LOGITS))
+
+    def test_top_p_keeps_nucleus(self):
+        # softmax([1,3,2,-1]) ~ [0.09, 0.66, 0.24, 0.01]: p=0.8 keeps {3, 2}
+        out = np.asarray(_filter_top_p(self.LOGITS, 0.8))
+        assert np.isfinite(out[[1, 2]]).all()
+        assert (out[[0, 3]] < -1e30).all()
+
+    def test_top_p_tiny_keeps_argmax(self):
+        out = np.asarray(_filter_top_p(self.LOGITS, 1e-6))
+        assert np.isfinite(out[1])
+        assert (np.delete(out, 1) < -1e30).all()
+
+
+class TestSample:
+    LOGITS = jnp.array([[1.0, 5.0, 2.0], [4.0, 0.0, 1.0]])
+
+    def test_greedy_is_argmax(self):
+        keys = jnp.stack([jax.random.PRNGKey(0)] * 2)
+        out = sample(self.LOGITS, keys, SamplingParams(temperature=0.0))
+        np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+    def test_top_k_1_equals_greedy(self):
+        keys = jnp.stack([jax.random.PRNGKey(i) for i in range(2)])
+        out = sample(self.LOGITS, keys,
+                     SamplingParams(temperature=1.0, top_k=1))
+        np.testing.assert_array_equal(np.asarray(out), [1, 0])
+
+    def test_sampled_tokens_respect_filter(self):
+        # top_k=2 on [1,5,2] can never emit index 0
+        params = SamplingParams(temperature=1.0, top_k=2)
+        for seed in range(20):
+            tok = sample_one(self.LOGITS[0], jax.random.PRNGKey(seed), params)
+            assert int(tok) in (1, 2)
+
+    def test_per_slot_keys_decorrelate(self):
+        logits = jnp.zeros((2, 1024))  # uniform: same key => same sample
+        same = jnp.stack([jax.random.PRNGKey(0)] * 2)
+        diff = jnp.stack([jax.random.PRNGKey(0), jax.random.PRNGKey(1)])
+        s_same = np.asarray(sample(logits, same, SamplingParams()))
+        s_diff = np.asarray(sample(logits, diff, SamplingParams()))
+        assert s_same[0] == s_same[1]
+        assert s_diff[0] != s_diff[1]
+
+    def test_slot_keys_deterministic_per_position(self):
+        base = jnp.stack([jax.random.PRNGKey(3)] * 2)
+        k1 = slot_keys(base, jnp.array([4, 5]))
+        k2 = slot_keys(base, jnp.array([4, 5]))
+        np.testing.assert_array_equal(np.asarray(k1), np.asarray(k2))
+        assert not np.array_equal(np.asarray(k1[0]), np.asarray(k1[1]))

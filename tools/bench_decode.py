@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Decode microbenchmark: KV-cache engine vs the retired recompute loop.
+
+Two arms over the same tiny GPT model (CPU-friendly sizes, >= 512
+generated tokens — ISSUE 4 acceptance):
+
+  * ``recompute``: the original cache-less sampler
+    (models/gpt_moe.generate_recompute) — a full O(S_max² · L) forward
+    per emitted token;
+  * ``cached``: the KV-cached ``generate`` — one prefill, then
+    O(S_max · L) per token against the cache;
+  * ``engine``: the same generation through the continuous-batching
+    InferenceEngine on a Llama config (prefill + per-step jitted decode
+    with host-side slot bookkeeping — the serving-loop overhead arm).
+
+Writes JSON under results/ (gitignored) and prints a table.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/bench_decode.py [--tokens 512]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _time_tokens(fn, n_tokens: int, repeats: int = 1):
+    """(tokens/s, seconds) for fn() generating n_tokens, after a warmup
+    call that eats compile time."""
+    fn()  # warmup + compile
+    t0 = time.perf_counter()
+    for _ in range(repeats):
+        fn()
+    dt = (time.perf_counter() - t0) / repeats
+    return n_tokens / dt, dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=512,
+                    help="generated tokens per arm (>= 512 for the "
+                         "acceptance run)")
+    ap.add_argument("--prompt", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--embd", type=int, default=64)
+    ap.add_argument("--repeats", type=int, default=1)
+    ap.add_argument("--out", default=os.path.join(REPO, "results",
+                                                  "bench_decode.json"))
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from scaletorch_tpu.models import gpt_moe, llama
+    from scaletorch_tpu.inference import InferenceEngine, SamplingParams
+
+    block = args.prompt + args.tokens
+    cfg = gpt_moe.GPTMoEConfig(
+        block_size=block, vocab_size=256, n_layer=args.layers, n_head=4,
+        n_embd=args.embd, use_moe=False,
+    )
+    params = gpt_moe.init_params(jax.random.PRNGKey(0), cfg)
+    prompt = (jnp.arange(args.prompt, dtype=jnp.int32) % 256)[None, :]
+
+    def run_cached():
+        out = gpt_moe.generate(params, prompt, cfg,
+                               max_new_tokens=args.tokens, temperature=0.0)
+        jax.block_until_ready(out)
+        return out
+
+    def run_recompute():
+        out = gpt_moe.generate_recompute(
+            params, prompt, cfg, max_new_tokens=args.tokens, temperature=0.0)
+        jax.block_until_ready(out)
+        return out
+
+    print(f"GPT block={block} L={args.layers} d={args.embd}; "
+          f"{args.tokens} tokens per arm")
+    cached_tps, cached_s = _time_tokens(run_cached, args.tokens,
+                                        args.repeats)
+    print(f"  cached    : {cached_tps:10.1f} tok/s  ({cached_s:.2f}s)")
+    recomp_tps, recomp_s = _time_tokens(run_recompute, args.tokens,
+                                        args.repeats)
+    print(f"  recompute : {recomp_tps:10.1f} tok/s  ({recomp_s:.2f}s)")
+
+    # sanity: both arms emit the same greedy continuation
+    same = bool(jnp.array_equal(run_cached(), run_recompute()))
+
+    # engine arm: llama tiny through the continuous-batching loop
+    lcfg = llama.LlamaConfig(
+        vocab_size=256, hidden_size=args.embd, intermediate_size=2 * args.embd,
+        num_hidden_layers=args.layers, num_attention_heads=4,
+        num_key_value_heads=2, dtype=jnp.float32,
+    )
+    lparams = llama.init_params(jax.random.PRNGKey(1), lcfg)
+
+    eng = InferenceEngine(
+        lparams, lcfg, max_slots=1, max_seq=block,
+        prefill_len=args.prompt,
+        sampling=SamplingParams(temperature=0.0),
+    )
+
+    def run_engine():
+        eng.submit(list(range(1, args.prompt + 1)),
+                   max_new_tokens=args.tokens)
+        eng.run()
+
+    run_engine()  # warmup: compiles the engine's prefill + decode steps
+    t0 = time.perf_counter()
+    run_engine()
+    engine_s = time.perf_counter() - t0
+    engine_tps = args.tokens / engine_s
+    print(f"  engine    : {engine_tps:10.1f} tok/s  ({engine_s:.2f}s)  "
+          f"[decode compiles: {eng.decode_compile_count}]")
+
+    speedup = cached_tps / recomp_tps
+    print(f"\n  cached vs recompute speedup: {speedup:.2f}x  "
+          f"(greedy outputs identical: {same})")
+
+    result = {
+        "config": {"block_size": block, "layers": args.layers,
+                   "embd": args.embd, "tokens": args.tokens,
+                   "prompt": args.prompt},
+        "cached_tokens_per_s": cached_tps,
+        "recompute_tokens_per_s": recomp_tps,
+        "engine_tokens_per_s": engine_tps,
+        "speedup_cached_vs_recompute": speedup,
+        "greedy_outputs_identical": same,
+        "backend": jax.default_backend(),
+    }
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=2)
+    print(f"  wrote {args.out}")
+    if speedup <= 1.0:
+        print("  WARNING: cached decode did not beat recompute", file=sys.stderr)
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()
