@@ -1,0 +1,81 @@
+"""jaxlint — JAX-aware static analysis for scaletorch-tpu.
+
+Run as ``python -m scaletorch_tpu.analysis [paths]``. Five passes over
+plain ASTs (nothing under analysis is imported):
+
+=====  ======================================================
+ST1xx  sharding-spec consistency (axis typos, dead spec keys)
+ST2xx  trace-safety (Python control flow / host syncs in jit)
+ST3xx  PRNG hygiene (key reuse, wall-clock seeds)
+ST4xx  donation safety (read-after-donate)
+ST5xx  retrace risk (literal args to jitted callables)
+=====  ======================================================
+
+Findings print as ``file:line: CODE severity message``; a checked-in
+baseline (``tools/jaxlint_baseline.json``) suppresses pre-existing
+findings so the CI gate only fails on NEW ones.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Set
+
+from . import donation, prng, retrace, sharding, trace_safety
+from .core import (
+    Finding,
+    SourceModule,
+    collect_files,
+    load_baseline,
+    save_baseline,
+    split_by_baseline,
+)
+from .scopes import ProjectIndex
+
+PASSES = {
+    "sharding": sharding.run,
+    "trace-safety": trace_safety.run,
+    "prng": prng.run,
+    "donation": donation.run,
+    "retrace": retrace.run,
+}
+
+__all__ = [
+    "Finding", "SourceModule", "ProjectIndex", "PASSES",
+    "collect_files", "load_baseline", "save_baseline", "split_by_baseline",
+    "analyze", "analyze_paths",
+]
+
+
+def analyze(
+    modules: Sequence[SourceModule],
+    select: Optional[Sequence[str]] = None,
+    extra_axes: Set[str] = frozenset(),
+) -> List[Finding]:
+    """Run the selected passes (default: all) over parsed modules."""
+    index = ProjectIndex(modules)
+    findings: List[Finding] = []
+    wanted = set(select) if select else set(PASSES)
+    unknown = wanted - set(PASSES)
+    if unknown:
+        raise ValueError(
+            f"unknown pass(es) {sorted(unknown)}; available: {sorted(PASSES)}"
+        )
+    for name, pass_fn in PASSES.items():
+        if name not in wanted:
+            continue
+        if name == "sharding":
+            findings.extend(pass_fn(index, extra_axes))
+        else:
+            findings.extend(pass_fn(index))
+    findings.sort(key=lambda f: (f.file, f.line, f.code))
+    return findings
+
+
+def analyze_paths(
+    paths: Sequence[str],
+    select: Optional[Sequence[str]] = None,
+    extra_axes: Set[str] = frozenset(),
+) -> tuple[List[Finding], List[Finding]]:
+    """(findings, syntax_errors) for files/directories on disk."""
+    modules, errors = collect_files(paths)
+    return analyze(modules, select=select, extra_axes=extra_axes), errors

@@ -1,0 +1,101 @@
+"""CLI: ``python -m scaletorch_tpu.analysis [paths] [options]``.
+
+Exit codes: 0 clean (or all findings baselined), 1 new findings or
+syntax errors, 2 usage error. ``--write-baseline`` records the current
+findings as the allowlist; the gate then only fails on regressions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from . import PASSES, analyze_paths, load_baseline, save_baseline, split_by_baseline
+
+DEFAULT_BASELINE = Path("tools") / "jaxlint_baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m scaletorch_tpu.analysis",
+        description="JAX-aware static analysis (jaxlint)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["scaletorch_tpu"],
+        help="files/directories to analyze (default: scaletorch_tpu)",
+    )
+    parser.add_argument(
+        "--baseline", type=Path, default=None,
+        help=f"baseline allowlist (default: {DEFAULT_BASELINE} if present)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="report every finding, ignoring any baseline file",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--select", default=None, metavar="PASS[,PASS...]",
+        help=f"run only these passes (available: {', '.join(sorted(PASSES))})",
+    )
+    parser.add_argument(
+        "--extra-axes", default="", metavar="AXIS[,AXIS...]",
+        help="additional mesh-axis names to treat as declared",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+    )
+    args = parser.parse_args(argv)
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()] \
+        if args.select else None
+    extra_axes = {s.strip() for s in args.extra_axes.split(",") if s.strip()}
+    try:
+        findings, errors = analyze_paths(
+            args.paths, select=select, extra_axes=extra_axes
+        )
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    baseline_path = args.baseline or (
+        DEFAULT_BASELINE if DEFAULT_BASELINE.is_file() else None
+    )
+    if args.write_baseline:
+        path = args.baseline or DEFAULT_BASELINE
+        path.parent.mkdir(parents=True, exist_ok=True)
+        save_baseline(path, findings)
+        print(f"wrote {len(findings)} finding(s) to {path}")
+        return 0
+
+    suppressed_count = 0
+    if baseline_path is not None and not args.no_baseline:
+        findings, suppressed = split_by_baseline(
+            findings, load_baseline(baseline_path)
+        )
+        suppressed_count = len(suppressed)
+
+    findings = list(errors) + findings
+    if args.format == "json":
+        print(json.dumps(
+            [f.__dict__ for f in findings], indent=2
+        ))
+    else:
+        for f in findings:
+            print(f.render())
+        n_err = sum(1 for f in findings if f.severity == "error")
+        n_warn = len(findings) - n_err
+        tail = f" ({suppressed_count} baselined)" if suppressed_count else ""
+        print(
+            f"jaxlint: {n_err} error(s), {n_warn} warning(s){tail}",
+            file=sys.stderr,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

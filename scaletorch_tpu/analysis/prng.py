@@ -1,0 +1,249 @@
+"""ST3xx — PRNG key hygiene.
+
+JAX keys are values, not stateful generators: feeding one key to two
+sampling calls gives **correlated** (often identical) draws, and the
+run still "works". The pass tracks key-like names through each function
+body in statement order:
+
+ST301  a key passed to a second sampling call with no intervening
+       ``jax.random.split``/``fold_in`` reassignment (loop bodies are
+       walked twice so cross-iteration reuse is caught)
+ST302  a key seeded from wall-clock/OS entropy (``time.*``,
+       ``os.urandom``, ``np.random``) inside a jit scope — the seed is
+       baked in at trace time, so every call reuses it
+
+Key-like names: parameters/variables matching ``key``/``rng``/
+``*_key``/``*_rng``/``prng*`` or assigned from ``PRNGKey``/``key``/
+``split``/``fold_in`` calls.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding
+from .scopes import ModuleScopes, ProjectIndex, dotted_name, tail_name
+
+_KEY_NAME_RE = re.compile(r"^(key|rng|prng\w*|\w+_key|\w+_rng|keys|rngs)$")
+# jax.random.* that CONSUME a key (first arg or key=)
+_SAMPLERS = {
+    "uniform", "normal", "categorical", "bernoulli", "gumbel", "randint",
+    "truncated_normal", "exponential", "beta", "gamma", "poisson", "choice",
+    "permutation", "shuffle", "bits", "laplace", "logistic", "cauchy",
+    "dirichlet", "multivariate_normal", "rademacher", "ball", "orthogonal",
+    "t", "loggamma", "binomial", "geometric",
+}
+_KEY_MAKERS = {"PRNGKey", "key", "split", "fold_in", "clone", "wrap_key_data"}
+_ENTROPY_SOURCES = ("time.", "os.urandom", "random.random", "np.random", "numpy.random")
+
+
+def run(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for ms in index.scopes.values():
+        findings.extend(_check_module(ms))
+    return findings
+
+
+def _is_random_call(node: ast.Call, wanted: Set[str]) -> Optional[str]:
+    """'categorical' if node is jax.random.categorical(...) (or
+    random.categorical via `from jax import random`), else None."""
+    d = dotted_name(node.func)
+    if not d:
+        return None
+    parts = d.split(".")
+    if parts[-1] not in wanted:
+        return None
+    if len(parts) >= 2 and parts[-2] in ("random", "jrandom", "jr"):
+        return parts[-1]
+    return None
+
+
+def _key_arg_names(node: ast.Call) -> List[ast.Name]:
+    """Name nodes passed in key position(s) of a sampler call."""
+    out: List[ast.Name] = []
+    if node.args and isinstance(node.args[0], ast.Name):
+        out.append(node.args[0])
+    for kw in node.keywords:
+        if kw.arg in ("key", "rng", "seed") and isinstance(kw.value, ast.Name):
+            out.append(kw.value)
+    return out
+
+
+class _FnChecker:
+    """Linear walk of one function body tracking consumed keys."""
+
+    def __init__(self, ms: ModuleScopes, fn) -> None:
+        self.ms = ms
+        self.fn = fn
+        # name -> line of the sampling call that consumed it (None = fresh)
+        self.consumed: Dict[str, int] = {}
+        self.key_names: Set[str] = set()
+        args = fn.args
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            if _KEY_NAME_RE.match(a.arg):
+                self.key_names.add(a.arg)
+        self.findings: List[Finding] = []
+        self.reported: Set[Tuple[int, str]] = set()
+
+    def _reset(self, name: str) -> None:
+        self.consumed.pop(name, None)
+
+    def _consume(self, name_node: ast.Name) -> None:
+        name = name_node.id
+        if name not in self.key_names:
+            return
+        prev = self.consumed.get(name)
+        if prev is not None:
+            key = (name_node.lineno, name)
+            if key not in self.reported:
+                self.reported.add(key)
+                self.findings.append(Finding(
+                    file=self.ms.sm.rel, line=name_node.lineno, code="ST301",
+                    severity="error",
+                    message=(
+                        f"PRNG key '{name}' reused by a second sampling call "
+                        f"(first consumed at line {prev}) without an "
+                        "intervening jax.random.split/fold_in — draws will "
+                        "be correlated"
+                    ),
+                ))
+        else:
+            self.consumed[name] = name_node.lineno
+
+    def _scan_expr(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call) and _is_random_call(node, _SAMPLERS):
+                for name_node in _key_arg_names(node):
+                    self._consume(name_node)
+
+    def _target_names(self, target: ast.AST) -> List[str]:
+        if isinstance(target, ast.Name):
+            return [target.id]
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for el in target.elts:
+                out.extend(self._target_names(el))
+            return out
+        if isinstance(target, ast.Starred):
+            return self._target_names(target.value)
+        return []
+
+    def _observe_assign(self, targets: List[ast.AST], value: ast.AST) -> None:
+        names: List[str] = []
+        for t in targets:
+            names.extend(self._target_names(t))
+        from_maker = (
+            isinstance(value, ast.Call) and _is_random_call(value, _KEY_MAKERS)
+        ) or (
+            # keys = split(...); k = keys[0] — subscript of a key var
+            isinstance(value, ast.Subscript)
+            and isinstance(value.value, ast.Name)
+            and value.value.id in self.key_names
+        )
+        for name in names:
+            if from_maker or _KEY_NAME_RE.match(name):
+                if from_maker:
+                    self.key_names.add(name)
+                self._reset(name)
+            elif name in self.key_names:
+                # rebound to something else entirely: stop tracking
+                self.key_names.discard(name)
+                self._reset(name)
+
+    def walk(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.Assign):
+                self._scan_expr(stmt.value)
+                self._observe_assign(stmt.targets, stmt.value)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                self._scan_expr(stmt.value)
+                self._observe_assign([stmt.target], stmt.value)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._scan_expr(stmt.iter)
+                # iterating over split keys binds a fresh key per step
+                for name in self._target_names(stmt.target):
+                    if _KEY_NAME_RE.match(name):
+                        self.key_names.add(name)
+                # walk twice: second pass catches cross-iteration reuse of
+                # keys consumed in pass one and never reset inside the body
+                self.walk(stmt.body)
+                for name in self._target_names(stmt.target):
+                    self._reset(name)
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, ast.While):
+                self._scan_expr(stmt.test)
+                self.walk(stmt.body)
+                self.walk(stmt.body)
+                self.walk(stmt.orelse)
+            elif isinstance(stmt, ast.If):
+                self._scan_expr(stmt.test)
+                before = dict(self.consumed)
+                self.walk(stmt.body)
+                after_body = self.consumed
+                self.consumed = dict(before)
+                self.walk(stmt.orelse)
+                # merge: consumed on either branch counts as consumed
+                for k, v in after_body.items():
+                    self.consumed.setdefault(k, v)
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self._scan_expr(item.context_expr)
+                self.walk(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                self.walk(stmt.body)
+                for handler in stmt.handlers:
+                    self.walk(handler.body)
+                self.walk(stmt.orelse)
+                self.walk(stmt.finalbody)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                self._scan_expr(stmt.value)
+            elif isinstance(stmt, ast.Expr):
+                self._scan_expr(stmt.value)
+            elif isinstance(stmt, ast.AugAssign):
+                self._scan_expr(stmt.value)
+
+
+def _check_module(ms: ModuleScopes) -> List[Finding]:
+    out: List[Finding] = []
+    traced_nodes = {fn for fn, _ in ms.traced_functions()}
+    for node in ast.walk(ms.sm.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        checker = _FnChecker(ms, node)
+        checker.walk(node.body)
+        out.extend(checker.findings)
+        if node in traced_nodes:
+            out.extend(_check_entropy_seeds(ms, node))
+    return out
+
+
+def _check_entropy_seeds(ms: ModuleScopes, fn: ast.AST) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Call)
+                and tail_name(node.func) in ("PRNGKey", "key")):
+            continue
+        for arg in node.args:
+            for inner in ast.walk(arg):
+                if not isinstance(inner, ast.Call):
+                    continue
+                d = dotted_name(inner.func) or ""
+                if any(d.startswith(src) or d == src.rstrip(".")
+                       for src in _ENTROPY_SOURCES):
+                    out.append(Finding(
+                        file=ms.sm.rel, line=node.lineno, code="ST302",
+                        severity="error",
+                        message=(
+                            f"PRNG key seeded from `{d}` inside a jit scope — "
+                            "the seed is a trace-time constant, every call "
+                            "reuses the same key"
+                        ),
+                    ))
+    return out

@@ -1,0 +1,89 @@
+"""ST5xx — retrace risk at jitted call sites.
+
+``jax.jit`` caches on the pytree *structure* and dtypes of its
+arguments. Call-site literals defeat the cache or bloat it:
+
+ST501  a dict/list literal passed to a jitted callable — structure is
+       rebuilt per call; a changed key set or length retraces silently
+       (lists also hash as pytrees of leaves: N leaves = N tracer args)
+ST502  a bare Python scalar literal in a position not covered by
+       ``static_argnums``/``static_argnames`` — weak-typed tracing
+       means the same callable invoked elsewhere with an array (or a
+       numpy scalar) of a different dtype traces again
+
+Both are warnings: each individual site works; the cost appears when a
+second call site disagrees, which is exactly when nobody is looking.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding
+from .scopes import (
+    ModuleScopes,
+    ProjectIndex,
+    collect_jitted_callables,
+    dotted_name,
+)
+
+
+def run(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for ms in index.scopes.values():
+        findings.extend(_check_module(index, ms))
+    return findings
+
+
+def _check_module(index: ProjectIndex, ms: ModuleScopes) -> List[Finding]:
+    jitted = collect_jitted_callables(index, ms)
+    if not jitted:
+        return []
+    out: List[Finding] = []
+    for call in ast.walk(ms.sm.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        cname = dotted_name(call.func)
+        info = jitted.get(cname) if cname else None
+        if info is None:
+            continue
+        static_idx = info.static_argnums
+        static_names = info.static_argnames
+        for i, arg in enumerate(call.args):
+            if static_idx is None or i in static_idx:
+                continue  # static (or unknown argnums: stay quiet)
+            out.extend(_check_arg(ms, cname, arg, f"positional arg {i}"))
+        for kw in call.keywords:
+            if kw.arg is None:
+                continue
+            if static_names is None or kw.arg in static_names:
+                continue
+            out.extend(_check_arg(ms, cname, kw.value, f"keyword {kw.arg}="))
+    return out
+
+
+def _check_arg(
+    ms: ModuleScopes, cname: str, arg: ast.AST, where: str
+) -> List[Finding]:
+    if isinstance(arg, (ast.Dict, ast.List)):
+        kind = "dict" if isinstance(arg, ast.Dict) else "list"
+        return [Finding(
+            file=ms.sm.rel, line=arg.lineno, code="ST501", severity="warning",
+            message=(
+                f"{kind} literal passed to jitted `{cname}` ({where}) — jit "
+                "caches on pytree structure, a changed key set/length "
+                "retraces silently; pass arrays/tuples or mark the arg static"
+            ),
+        )]
+    if isinstance(arg, ast.Constant) and type(arg.value) in (int, float, bool):
+        return [Finding(
+            file=ms.sm.rel, line=arg.lineno, code="ST502", severity="warning",
+            message=(
+                f"Python scalar literal {arg.value!r} passed to jitted "
+                f"`{cname}` ({where}) outside static_argnums — weak-typed "
+                "tracing retraces when another site passes an array; use "
+                "static_argnums or jnp.asarray"
+            ),
+        )]
+    return []

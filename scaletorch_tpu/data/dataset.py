@@ -11,7 +11,7 @@ multiprocess ``.map`` tokenization.
 from __future__ import annotations
 
 import os
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 import numpy as np
 

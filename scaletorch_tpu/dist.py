@@ -30,13 +30,12 @@ from typing import Any, Optional, Sequence
 import jax
 import numpy as np
 
-from scaletorch_tpu.utils.logger import get_logger
-
-_initialized = False
-
 # Env names: JAX-native first, torchrun-style fallback (reference
 # _init_dist_pytorch reads RANK/WORLD_SIZE/MASTER_*, dist/utils.py:152-165).
 from scaletorch_tpu.env import ENV_LAUNCHER_RANK_VARS as _PID_VARS
+from scaletorch_tpu.utils.logger import get_logger
+
+_initialized = False
 
 _COORD_VARS = ("JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS")
 _NPROC_VARS = ("JAX_NUM_PROCESSES", "NUM_PROCESSES", "WORLD_SIZE")

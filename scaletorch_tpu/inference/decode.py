@@ -21,7 +21,7 @@ Both lower onto the models' cache-aware forwards
 
 from __future__ import annotations
 
-from typing import Callable, Optional, Tuple
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp

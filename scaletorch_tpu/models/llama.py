@@ -23,7 +23,6 @@ config flags, so there is a single decoder implementation to optimise.
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple

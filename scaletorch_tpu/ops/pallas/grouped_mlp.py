@@ -28,7 +28,6 @@ off-TPU execution path).
 from __future__ import annotations
 
 import functools
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

@@ -32,7 +32,6 @@ import math
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
 from scaletorch_tpu.models.registry import register_attention_backend
 from scaletorch_tpu.parallel.tensor_parallel import pvary_missing

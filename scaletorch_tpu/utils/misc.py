@@ -16,7 +16,6 @@ import random
 from typing import Any, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from scaletorch_tpu.utils.device import get_theoretical_flops

@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The lint gate, runnable locally and in CI (.github/workflows/tier1.yml
+# `lint` job runs exactly this script).
+#
+#   bash scripts/run_lint.sh
+#
+# Two checks:
+#   1. jaxlint  — python -m scaletorch_tpu.analysis over the package and
+#      tools/, gated on tools/jaxlint_baseline.json (new findings fail).
+#   2. ruff     — pycodestyle/pyflakes/isort per [tool.ruff] in
+#      pyproject.toml. Skipped with a warning when ruff isn't installed
+#      (the TPU dev containers don't ship it; CI installs it).
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== jaxlint (python -m scaletorch_tpu.analysis) =="
+JAX_PLATFORMS=cpu python -m scaletorch_tpu.analysis scaletorch_tpu/ tools/ || rc=1
+
+echo "== ruff check =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check scaletorch_tpu/ tools/ tests/ scripts/ train.py bench.py || rc=1
+else
+    echo "ruff not installed; skipping (pip install ruff, or rely on CI)"
+fi
+
+exit $rc

@@ -1,0 +1,59 @@
+"""jaxlint fixture: donation bugs. Parsed, never imported."""
+
+import jax
+import jax.numpy as jnp
+
+
+def make_decode_step():
+    def decode(params, tokens, cache):
+        new_cache = cache + tokens.sum()
+        return tokens * 2, new_cache
+
+    return jax.jit(decode, donate_argnums=(2,))
+
+
+def serve(params, tokens, cache):
+    step = make_decode_step()
+    out, new_cache = step(params, tokens, cache)
+    stale = cache.sum()          # ST401: cache was donated to step()
+    return out, new_cache, stale
+
+
+def serve_correctly(params, tokens, cache):
+    step = make_decode_step()
+    out, cache = step(params, tokens, cache)  # rebinds: fine
+    return out, cache.sum()
+
+
+class Engine:
+    """The inference-engine shape: donated KV cache held on self."""
+
+    def __init__(self, params):
+        self.params = params
+        self.cache = jnp.zeros((2, 8))
+        self._decode = make_decode_step()
+
+    def decode_step(self, tokens):
+        out, new_cache = self._decode(self.params, tokens, self.cache)
+        occupancy = self.cache.sum()   # ST401: self.cache was donated
+        self.cache = new_cache
+        return out, occupancy
+
+    def decode_step_ok(self, tokens):
+        out, self.cache = self._decode(self.params, tokens, self.cache)
+        return out                     # rebound in the call stmt: fine
+
+    def decode_step_self_read(self, tokens):
+        out = self._decode(self.params, tokens, self.cache)
+        # ST401: the rebinding expression READS the dead donated buffer
+        self.cache = jnp.where(tokens[0] > 0, self.cache, self.cache)
+        return out
+
+
+update = jax.jit(lambda p, g: jax.tree.map(jnp.add, p, g), donate_argnums=(0,))
+
+
+def train(params, grads):
+    new_params = update(params, grads)
+    norm = jnp.linalg.norm(params["w"])  # ST401: params donated to update()
+    return new_params, norm

@@ -1,0 +1,33 @@
+"""jaxlint fixture: PRNG hygiene bugs. Parsed, never imported."""
+
+import time
+
+import jax
+
+
+def sample_twice(logits, key):
+    a = jax.random.categorical(key, logits)
+    b = jax.random.gumbel(key, logits.shape)   # ST301: key reused, no split
+    return a, b
+
+
+def loop_reuse(key, n):
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.normal(key, (4,)))  # ST301: reused across iters
+    return outs
+
+
+def correct_usage(key, logits):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.categorical(k1, logits)
+    b = jax.random.gumbel(k2, logits.shape)    # fine: split keys
+    key, sub = jax.random.split(key)
+    c = jax.random.normal(sub, (2,))           # fine: key was re-split
+    return a, b, c
+
+
+@jax.jit
+def clock_seeded(x):
+    key = jax.random.PRNGKey(int(time.time()))  # ST302: trace-time seed
+    return x + jax.random.normal(key, x.shape)

@@ -1,0 +1,25 @@
+"""jaxlint fixture: retrace-risk bugs. Parsed, never imported."""
+
+import jax
+
+
+def make_step():
+    def step(params, batch, lr):
+        return jax.tree.map(lambda p: p - lr * batch["x"].sum(), params)
+
+    return jax.jit(step)
+
+
+step = make_step()
+fwd = jax.jit(lambda p, x, training: p["w"] * x, static_argnums=(2,))
+
+
+def train(params, x):
+    out = step(params, {"x": x}, 0.01)   # ST501 dict literal + ST502 scalar
+    out2 = fwd(params, [1.0, 2.0], True)  # ST501 list; True is static: no ST502
+    return out, out2
+
+
+def train_ok(params, batch, lr_arr, x):
+    out = step(params, batch, lr_arr)    # fine: no literals
+    return out, fwd(params, x, False)    # static position: fine

@@ -1,0 +1,29 @@
+"""jaxlint fixture: sharding-spec bugs. Parsed, never imported."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "pp", "cp", "ep", "tp")
+
+
+def make_params():
+    return {"q_proj": 1, "k_proj": 2, "layers": {"down_proj": 3}}
+
+
+def llama_param_specs(tp_axis="tpp"):  # ST101: typo'd default
+    return {
+        "q_proj": P(None, "tp"),
+        "k_proj": P(None, "mdl"),    # ST101: 'mdl' is not a mesh axis
+        "q_porj": P(None, "tp"),     # ST102: key the param tree never defines
+    }
+
+
+def data_specs(mesh):
+    seq_axis = "ctx"                  # ST101: assignment to *_axis
+    spec = P(("dp", "epp"), None)     # ST101: 'epp'
+    return NamedSharding(mesh, spec), seq_axis
+
+
+def apply(mesh, x):
+    sh = NamedSharding(mesh, P("dp", "tensor"))  # ST101: 'tensor'
+    return jax.device_put(x, sh)

@@ -1,0 +1,46 @@
+"""jaxlint fixture: trace-safety bugs. Parsed, never imported."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def branchy_loss(params, batch):
+    loss = jnp.mean((params["w"] * batch["x"] - batch["y"]) ** 2)
+    if loss > 1.0:          # ST201: Python branch on a tracer
+        loss = loss * 0.5
+    return loss
+
+
+@partial(jax.jit, static_argnames=("scale",))
+def host_sync_step(grads, scale):
+    norm = jnp.sqrt(sum(jnp.sum(g * g) for g in grads))
+    if scale:               # static arg: must NOT flag
+        norm = norm * scale
+    host = float(norm)      # ST202: host sync on a tracer
+    print("norm", host)     # ST204: trace-time print
+    return norm
+
+
+def make_step():
+    def step(x):
+        t0 = time.time()    # ST205: trace-time clock
+        y = np.log(x)       # ST203: host numpy on a tracer
+        while y.sum() > 0:  # ST201: Python while on a tracer
+            y = y - 1
+        return y, t0
+
+    return jax.jit(step)
+
+
+def scan_user(xs):
+    def body(carry, x):
+        if x > 0:           # ST201: scan body branches on a tracer
+            carry = carry + x
+        return carry, carry
+
+    return jax.lax.scan(body, jnp.float32(0.0), xs)

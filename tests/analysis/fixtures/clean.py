@@ -1,0 +1,87 @@
+"""jaxlint fixture: idiomatic traced code — every pass must stay quiet.
+
+Exercises the idioms the passes must NOT flag: lax.cond/scan/while_loop
+control flow, branching on static facts (shape/dtype/is None/static
+args), split-then-sample PRNG use, donated buffers that are rebound,
+and jitted calls fed arrays and static-marked config.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("dp", "pp", "cp", "ep", "tp")
+
+
+def make_params():
+    return {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+
+
+def param_specs(tp_axis="tp", pp_axis=None):
+    return {"w": P(pp_axis, tp_axis), "b": P(None)}
+
+
+def shardings(mesh):
+    return NamedSharding(mesh, P(("dp", "cp"), None, "tp"))
+
+
+@partial(jax.jit, static_argnames=("training",))
+def forward(params, x, key, training):
+    if training:                      # static arg: fine
+        k_drop, k_noise = jax.random.split(key)
+        x = x * jax.random.bernoulli(k_drop, 0.9, x.shape)
+        x = x + 0.01 * jax.random.normal(k_noise, x.shape)
+    if x.ndim == 1:                   # shape fact: fine
+        x = x[None, :]
+    h = x @ params["w"] + params["b"]
+    return lax.cond(                  # traced branch, the right way
+        jnp.mean(h) > 0.0,
+        lambda v: v * 2.0,
+        lambda v: v * 0.5,
+        h,
+    )
+
+
+@jax.jit
+def stepped_sum(xs, mask):
+    def body(carry, inp):
+        x, m = inp
+        carry = carry + jnp.where(m, x, 0.0)   # traced select, fine
+        return carry, carry
+
+    total, partials = lax.scan(body, jnp.float32(0.0), (xs, mask))
+
+    def keep_going(state):
+        i, acc = state
+        return i < xs.shape[0]                 # shape bound: fine
+
+    def advance(state):
+        i, acc = state
+        return i + 1, acc + partials[i]
+
+    _, acc = lax.while_loop(keep_going, advance, (0, jnp.float32(0.0)))
+    return total, acc
+
+
+train_step = jax.jit(
+    lambda p, g: jax.tree.map(lambda a, b: a - 0.1 * b, p, g),
+    donate_argnums=(0,),
+)
+
+
+def fit(params, grads_list):
+    for grads in grads_list:
+        params = train_step(params, grads)     # donated + rebound: fine
+    return params
+
+
+def evaluate(params, batches, key):
+    total = jnp.float32(0.0)
+    for batch in batches:
+        key, sub = jax.random.split(key)       # re-split per iter: fine
+        noise = jax.random.normal(sub, batch.shape)
+        total = total + forward(params, batch + noise, sub, False).sum()
+    return float(total)                        # host sync OUTSIDE jit: fine

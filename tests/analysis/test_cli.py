@@ -1,0 +1,144 @@
+"""CLI contract: output format, exit codes, baseline round-trip."""
+
+import json
+from pathlib import Path
+
+from scaletorch_tpu.analysis import Finding, save_baseline, split_by_baseline
+from scaletorch_tpu.analysis.__main__ import main
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def run_cli(capsys, *argv):
+    rc = main(list(argv))
+    captured = capsys.readouterr()
+    return rc, captured.out, captured.err
+
+
+class TestExitCodes:
+    def test_clean_file_exits_zero(self, capsys):
+        rc, out, _ = run_cli(capsys, str(FIXTURES / "clean.py"), "--no-baseline")
+        assert rc == 0 and out == ""
+
+    def test_findings_exit_one(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_sharding.py"), "--no-baseline"
+        )
+        assert rc == 1
+        assert "ST101" in out
+
+    def test_unknown_pass_exits_two(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--select", "nonsense"
+        )
+        assert rc == 2
+        assert "unknown pass" in err
+
+    def test_nonexistent_path_exits_two(self, capsys):
+        """A typo'd path must not turn the gate silently green."""
+        rc, _, err = run_cli(capsys, "no_such_dir_typo", "--no-baseline")
+        assert rc == 2
+        assert "no_such_dir_typo" in err
+
+    def test_syntax_error_reported_not_crash(self, capsys, tmp_path):
+        bad = tmp_path / "broken.py"
+        bad.write_text("def f(:\n")
+        rc, out, _ = run_cli(capsys, str(bad), "--no-baseline")
+        assert rc == 1
+        assert "JL000" in out
+
+
+class TestOutputFormat:
+    def test_text_format_is_file_line_code_severity(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_donation.py"), "--no-baseline"
+        )
+        line = out.splitlines()[0]
+        # file:line: CODE severity message
+        loc, rest = line.split(": ", 1)
+        assert loc.endswith("bad_donation.py:18")
+        code, severity = rest.split(" ")[:2]
+        assert code == "ST401" and severity == "error"
+
+    def test_json_format(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_retrace.py"), "--no-baseline",
+            "--format", "json",
+        )
+        data = json.loads(out)
+        assert rc == 1 and data
+        assert {"file", "line", "code", "severity", "message"} <= set(data[0])
+
+    def test_select_restricts_passes(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_sharding.py"), "--no-baseline",
+            "--select", "donation",
+        )
+        assert rc == 0 and out == ""
+
+
+class TestBaseline:
+    def test_write_then_gate_passes(self, capsys, tmp_path):
+        baseline = tmp_path / "baseline.json"
+        rc, _, _ = run_cli(
+            capsys, str(FIXTURES / "bad_trace.py"),
+            "--baseline", str(baseline), "--write-baseline",
+        )
+        assert rc == 0
+        entries = json.loads(baseline.read_text())["findings"]
+        assert entries and all(
+            {"file", "code", "message"} <= set(e) for e in entries
+        )
+        rc, out, err = run_cli(
+            capsys, str(FIXTURES / "bad_trace.py"), "--baseline", str(baseline)
+        )
+        assert rc == 0 and out == ""
+        assert "baselined" in err
+
+    def test_new_finding_still_fails_with_baseline(self, capsys, tmp_path):
+        baseline = tmp_path / "baseline.json"
+        run_cli(
+            capsys, str(FIXTURES / "bad_trace.py"),
+            "--baseline", str(baseline), "--write-baseline",
+        )
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_trace.py"),
+            str(FIXTURES / "bad_prng.py"), "--baseline", str(baseline),
+        )
+        assert rc == 1
+        assert "bad_prng" in out and "bad_trace" not in out
+
+    def test_extra_axes_flag(self, capsys, tmp_path):
+        f = tmp_path / "custom.py"
+        f.write_text(
+            "from jax.sharding import PartitionSpec as P\n"
+            "SPEC = P('stage', None)\n"
+        )
+        rc1, _, _ = run_cli(capsys, str(f), "--no-baseline")
+        rc2, _, _ = run_cli(
+            capsys, str(f), "--no-baseline", "--extra-axes", "stage"
+        )
+        assert (rc1, rc2) == (1, 0)
+
+
+class TestBaselineBudget:
+    def test_duplicate_findings_consume_budget(self):
+        f = Finding(file="a.py", line=1, code="ST101", severity="error",
+                    message="m")
+        dup = Finding(file="a.py", line=9, code="ST101", severity="error",
+                      message="m")
+        entries = [{"file": "a.py", "code": "ST101", "message": "m"}]
+        new, suppressed = split_by_baseline([f, dup], entries)
+        assert len(suppressed) == 1 and len(new) == 1
+
+    def test_save_baseline_sorted_and_stable(self, tmp_path):
+        p = tmp_path / "b.json"
+        fs = [
+            Finding(file="b.py", line=2, code="ST201", severity="error",
+                    message="x"),
+            Finding(file="a.py", line=5, code="ST101", severity="error",
+                    message="y"),
+        ]
+        save_baseline(p, fs)
+        entries = json.loads(p.read_text())["findings"]
+        assert [e["file"] for e in entries] == ["a.py", "b.py"]

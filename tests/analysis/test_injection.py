@@ -1,0 +1,89 @@
+"""The sharding pass catches bugs injected into the REAL framework
+sources — the typo-means-replicated class the pass exists for.
+
+Each test copies a production module, injects one character-level bug,
+and asserts the pass reports it (and nothing else new) against the
+same module set the CI gate lints.
+"""
+
+from pathlib import Path
+
+from scaletorch_tpu.analysis import analyze, collect_files
+
+REPO = Path(__file__).resolve().parents[2]
+PKG = REPO / "scaletorch_tpu"
+
+
+def _analyze_with(tmp_path, mutated_name, mutated_src, companions):
+    mutated = tmp_path / mutated_name
+    mutated.write_text(mutated_src, encoding="utf-8")
+    paths = [str(mutated)] + [str(PKG / c) for c in companions]
+    modules, errors = collect_files(paths)
+    assert not errors
+    return analyze(modules, select=["sharding"])
+
+
+class TestInjectedAxisTypo:
+    COMPANIONS = ["parallel/mesh.py", "models/llama.py"]
+
+    def test_llama_param_specs_axis_typo_detected(self, tmp_path):
+        src = (PKG / "parallel" / "tensor_parallel.py").read_text()
+        needle = 'tp_axis: Optional[str] = "tp"'
+        assert needle in src, "llama_param_specs signature moved; update test"
+        findings = _analyze_with(
+            tmp_path, "tensor_parallel.py",
+            src.replace(needle, 'tp_axis: Optional[str] = "tpq"'),
+            self.COMPANIONS,
+        )
+        assert any(
+            f.code == "ST101" and "'tpq'" in f.message for f in findings
+        ), [f.render() for f in findings]
+
+    def test_unmutated_source_is_clean(self, tmp_path):
+        src = (PKG / "parallel" / "tensor_parallel.py").read_text()
+        findings = _analyze_with(
+            tmp_path, "tensor_parallel.py", src, self.COMPANIONS
+        )
+        assert findings == [], [f.render() for f in findings]
+
+    def test_llama_param_specs_key_typo_detected(self, tmp_path):
+        src = (PKG / "parallel" / "tensor_parallel.py").read_text()
+        needle = '"q_proj": P(pstg, None, t)'
+        assert needle in src, "llama_param_specs body moved; update test"
+        findings = _analyze_with(
+            tmp_path, "tensor_parallel.py",
+            src.replace(needle, '"q_porj": P(pstg, None, t)'),
+            self.COMPANIONS,
+        )
+        assert any(
+            f.code == "ST102" and "'q_porj'" in f.message for f in findings
+        ), [f.render() for f in findings]
+
+    def test_kv_cache_specs_axis_typo_detected(self, tmp_path):
+        src = (PKG / "inference" / "kv_cache.py").read_text()
+        needle = 'tp_axis: Optional[str] = "tp"'
+        assert needle in src, "kv_cache_specs signature moved; update test"
+        findings = _analyze_with(
+            tmp_path, "kv_cache.py",
+            src.replace(needle, 'tp_axis: Optional[str] = "tb"', 1),
+            ["parallel/mesh.py"],
+        )
+        assert any(
+            f.code == "ST101" and "'tb'" in f.message for f in findings
+        ), [f.render() for f in findings]
+
+
+class TestRepoGate:
+    def test_package_and_tools_lint_clean_with_baseline(self):
+        """The exact CI gate: repo findings minus baseline is empty."""
+        from scaletorch_tpu.analysis import load_baseline, split_by_baseline
+
+        modules, errors = collect_files(
+            [str(PKG), str(REPO / "tools")], root=REPO
+        )
+        assert not errors, [e.render() for e in errors]
+        findings = analyze(modules)
+        baseline_path = REPO / "tools" / "jaxlint_baseline.json"
+        entries = load_baseline(baseline_path) if baseline_path.is_file() else []
+        new, _ = split_by_baseline(findings, entries)
+        assert new == [], [f.render() for f in new]

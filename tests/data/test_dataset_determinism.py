@@ -13,7 +13,6 @@ same-dataset assumption, dataloader.py:170-186).
 
 from __future__ import annotations
 
-import hashlib
 import json
 import os
 import subprocess

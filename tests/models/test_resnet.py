@@ -2,11 +2,10 @@
 
 from __future__ import annotations
 
-import pytest
-
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from scaletorch_tpu.models.resnet import ResNetConfig, forward, init_params
 

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from scaletorch_tpu.models.layers import cross_entropy_loss, sdpa_attention
+from scaletorch_tpu.models.layers import sdpa_attention
 from scaletorch_tpu.models.llama import LlamaConfig, forward, init_params
 from scaletorch_tpu.ops.ring_attention import ring_attention
 from scaletorch_tpu.parallel.mesh import MeshManager

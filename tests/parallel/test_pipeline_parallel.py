@@ -13,7 +13,6 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from scaletorch_tpu.models.layers import cross_entropy_loss
 from scaletorch_tpu.models.llama import LlamaConfig, forward, init_params
 from scaletorch_tpu.parallel.mesh import MeshManager
 from scaletorch_tpu.parallel.pipeline_parallel import (

@@ -180,8 +180,6 @@ class TestValidation:
 @pytest.mark.slow
 class TestSpmdTrainStep:
     def test_dp_tp_sp_step_matches_single_device(self, setup):
-        import copy
-
         from scaletorch_tpu.config import ScaleTorchTPUArguments
         from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
         from scaletorch_tpu.trainer.optimizer import create_optimizer

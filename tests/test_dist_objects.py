@@ -6,7 +6,6 @@ invariants every helper must keep (reference object_ops.py ones: torch
 gather_object degenerates to identity at world_size 1).
 """
 
-import pytest
 
 from scaletorch_tpu.dist import (
     all_gather_object,

@@ -706,7 +706,7 @@ class TestEndToEndFaults:
         assert t2._loader_skew == 2  # both retired batches stay retired
         # next draw = consumed-position + skew, never a replay
         pos = t2.global_step + t2._loader_skew
-        nxt = t2.step()
+        t2.step()  # consumes the draw at `pos`
         ref_it = iter(MicroBatchDataLoader(
             e2e_tokens(), micro_batch_size=2,
             gradient_accumulation_steps=2, seed=cfg2.seed))

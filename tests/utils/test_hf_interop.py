@@ -7,11 +7,10 @@ load_hf_params, and compare logits token-for-token. Also round-trips
 save_hf_params back into transformers.
 """
 
-import numpy as np
-import pytest
-
 import jax
 import jax.numpy as jnp
+import numpy as np
+import pytest
 
 transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
