@@ -1,0 +1,155 @@
+"""jax API compatibility layer — one module, imported once, at package init.
+
+The container's jax 0.4.37 predates three APIs the SPMD stack is written
+against (the "old-jax compat guards" that took the quick tier 273->382
+lived inline in rms_norm / MeshManager / pvary_missing; this module is
+that pattern promoted to a single backfill point):
+
+  * ``jax.shard_map``          — lives at ``jax.experimental.shard_map``
+                                 in 0.4.x. Backfilled with
+                                 ``check_rep=False``: the old replication
+                                 checker predates several primitives'
+                                 rep rules (pallas_call, all_to_all in
+                                 some layouts) and its rejection is a
+                                 strict superset of what the new
+                                 check_vma machinery enforces.
+  * ``jax.lax.pvary``          — the VMA varying-axes marker. On builds
+                                 without the VMA type system there is no
+                                 bookkeeping to update: identity.
+  * ``jax.typeof``             — backfilled with ``jax.core.get_aval``;
+                                 the returned aval has no ``.vma``, which
+                                 every caller already tolerates via
+                                 ``getattr(typeof(x), "vma", ())``.
+
+Gradient semantics: the one place where identity-``pvary`` is NOT enough
+is differentiating *inside* a ``shard_map`` body through a forward
+``psum`` (the Megatron g-function sites: row-parallel outputs, the
+vocab-parallel embedding/CE reductions). New jax's VMA machinery gives
+the cotangent of the psum *input* as the (replicated) output cotangent —
+a collective-free backward. Old shard_map without rep rewriting instead
+transposes psum to psum, inflating every upstream gradient by the axis
+size (measured, not theory: a 2-rank tp mesh yields exactly 2x). The fix
+is ``psum_replicated_ct`` below: the same psum, with the new-jax
+cotangent rule stated explicitly as a ``custom_vjp`` on old builds. Its
+correctness requires the cotangent arriving from downstream to be
+replicated over ``axis`` — true at every call site, because everything
+downstream of these reductions (residual stream, loss) is replicated
+over tp. On new jax it IS ``jax.lax.psum`` (the custom_vjp would only
+hide the native VMA bookkeeping).
+
+Import-order contract: ``scaletorch_tpu/__init__`` imports this module
+before any other package module, so every caller (and the test suite,
+which imports the package via conftest) sees one consistent jax surface.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+# Feature probes BEFORE any backfill: these flags describe the real jax,
+# not the shimmed one.
+HAS_VMA: bool = hasattr(jax.lax, "pvary")
+HAS_SHARD_MAP: bool = hasattr(jax, "shard_map")
+
+
+def _backfill_shard_map() -> None:
+    from jax.experimental.shard_map import shard_map as _old_shard_map
+
+    def shard_map(f=None, *, mesh, in_specs, out_specs, **kwargs):
+        """``jax.shard_map`` signature over the 0.4.x implementation.
+
+        ``check_vma``/``axis_names`` (new-jax knobs) are accepted and
+        dropped; replication checking runs as ``check_rep=False`` (see
+        module docstring).
+        """
+        kwargs.pop("check_vma", None)
+        kwargs.pop("axis_names", None)
+        if kwargs:
+            # Never swallow semantics: an unknown (likely newer-jax)
+            # kwarg must fail loudly, not run with different behavior.
+            raise TypeError(
+                f"shard_map backfill got unsupported kwargs "
+                f"{sorted(kwargs)} on jax {jax.__version__}"
+            )
+        if f is None:  # decorator / partial-application form
+            return partial(
+                shard_map, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, **kwargs,
+            )
+        return _old_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
+
+    jax.shard_map = shard_map
+
+
+def _backfill_pvary() -> None:
+    def pvary(x, axis_name):
+        """No VMA type system to update on this build: identity."""
+        del axis_name
+        return x
+
+    jax.lax.pvary = pvary
+
+
+def _backfill_typeof() -> None:
+    def typeof(x):
+        return jax.core.get_aval(x)
+
+    jax.typeof = typeof
+
+
+def _backfill_axis_size() -> None:
+    def axis_size(axis_name):
+        # The pre-0.5 idiom: psum of a concrete 1 over a named axis is
+        # evaluated eagerly to the (static) axis size, under tracing too.
+        return jax.lax.psum(1, axis_name)
+
+    jax.lax.axis_size = axis_size
+
+
+if not HAS_SHARD_MAP:
+    _backfill_shard_map()
+if not HAS_VMA:
+    _backfill_pvary()
+if not hasattr(jax, "typeof"):
+    _backfill_typeof()
+if not hasattr(jax.lax, "axis_size"):
+    _backfill_axis_size()
+
+
+# ---------------------------------------------------------------------------
+# psum with the new-jax cotangent rule, explicit.
+# ---------------------------------------------------------------------------
+if HAS_VMA:
+    def psum_replicated_ct(x, axis):
+        """On VMA builds this is exactly ``jax.lax.psum`` — the type
+        system already derives the replicated-cotangent backward."""
+        return jax.lax.psum(x, axis)
+else:
+    @partial(jax.custom_vjp, nondiff_argnums=(1,))
+    def psum_replicated_ct(x, axis):
+        return jax.lax.psum(x, axis)
+
+    def _psum_fwd(x, axis):
+        return jax.lax.psum(x, axis), None
+
+    def _psum_bwd(axis, _res, ct):
+        # The output is replicated over ``axis`` and so (at every call
+        # site — see module docstring) is its cotangent: each shard's
+        # contribution to the sum sees the full output cotangent.
+        return (ct,)
+
+    psum_replicated_ct.defvjp(_psum_fwd, _psum_bwd)
+
+
+def pallas_tpu_compiler_params(pltpu_module, **kwargs):
+    """``pltpu.CompilerParams`` was ``TPUCompilerParams`` before jax 0.6;
+    build whichever this jax ships."""
+    cls = getattr(pltpu_module, "CompilerParams", None)
+    if cls is None:
+        cls = pltpu_module.TPUCompilerParams
+    return cls(**kwargs)

@@ -101,10 +101,15 @@ class ModelArguments:
     tie_word_embeddings: bool = False
     attention_backend: str = field(
         default="auto",
-        metadata={"help": "auto | flash | flash_jax | ring | sdpa — auto "
-                          "resolves like the reference (CP->ring, "
-                          "FLASH_ATTEN->flash, else sdpa); flash_jax is "
-                          "jax's reference TPU kernel for on-chip A/B."},
+        metadata={"help": "auto | flash | flash_jax | ring | ulysses | "
+                          "sdpa — with cp > 1, auto picks ring vs "
+                          "ulysses from mesh topology + head geometry "
+                          "(parallel/cp_select.resolve_cp_backend, "
+                          "attested by AOT_CP_CROSSOVER.json); without "
+                          "CP it resolves like the reference "
+                          "(FLASH_ATTEN->flash, else sdpa). flash_jax "
+                          "is jax's reference TPU kernel for on-chip "
+                          "A/B; an explicit backend is always honored."},
     )
     # MoE knobs (qwen3_moe / gpt_moe)
     num_experts: int = 8
@@ -199,6 +204,30 @@ class ParallelArguments:
         default=None,
         metadata={"help": "PP microbatches; defaults to gradient_accumulation_steps."},
     )
+    grad_allreduce_dtype: str = field(
+        default="fp32",
+        metadata={"help": "fp32 | bf16 | int8 — wire format of the "
+                          "gradient mean over grad_allreduce_axis (the "
+                          "bandwidth-bound DCN edge on multi-host "
+                          "meshes). int8 is the block-scaled quantized "
+                          "all-reduce (ops/quantized_collectives.py, "
+                          "~4x fewer bytes; grad cosine vs fp32 >= "
+                          "0.999); bf16 halves bytes with a plain cast. "
+                          "Other data axes and the tp/pp psums stay "
+                          "fp32 (they ride ICI)."},
+    )
+    grad_allreduce_axis: str = field(
+        default="dp",
+        metadata={"help": "Mesh axis the quantized/bf16 gradient mean "
+                          "runs over ('dp' or 'cp'); the remaining data "
+                          "axes reduce in fp32 first."},
+    )
+    grad_allreduce_block_size: int = field(
+        default=256,
+        metadata={"help": "Elements per absmax-scale block for "
+                          "grad_allreduce_dtype='int8' (fp32 scale per "
+                          "block: overhead 4/block_size)."},
+    )
 
     def __post_init__(self) -> None:
         for name in (
@@ -260,6 +289,21 @@ class ParallelArguments:
             )
         if self.sequence_parallel and self.tensor_parallel_size == 1:
             raise ValueError("sequence_parallel requires tensor_parallel_size > 1")
+        if self.grad_allreduce_dtype not in ("fp32", "bf16", "int8"):
+            raise ValueError(
+                "grad_allreduce_dtype must be 'fp32', 'bf16' or 'int8', "
+                f"got {self.grad_allreduce_dtype!r}"
+            )
+        if self.grad_allreduce_axis not in ("dp", "cp"):
+            raise ValueError(
+                "grad_allreduce_axis must be 'dp' or 'cp' (a gradient-mean "
+                f"data axis), got {self.grad_allreduce_axis!r}"
+            )
+        if self.grad_allreduce_block_size < 8:
+            raise ValueError(
+                "grad_allreduce_block_size must be >= 8, got "
+                f"{self.grad_allreduce_block_size}"
+            )
 
 
 @dataclass
@@ -659,8 +703,12 @@ class ScaleTorchTPUArguments(
             )
         if (self.context_parallel_size > 1 and self.cp_layout == "zigzag"
                 # ulysses owns whole heads — the zigzag layout (and its
-                # stricter divisibility) never applies to it
-                and self.attention_backend != "ulysses"
+                # stricter divisibility) never applies to it. 'auto' may
+                # resolve to ulysses too (topology-aware selection needs
+                # the mesh, which doesn't exist at config time), so its
+                # divisibility is checked by the Trainer AFTER
+                # resolve_cp_backend settles the backend.
+                and self.attention_backend not in ("ulysses", "auto")
                 and self.sequence_length % (2 * self.context_parallel_size)):
             raise ValueError(
                 f"cp_layout='zigzag' needs sequence_length "

@@ -7,5 +7,11 @@ model import (reference models/llama.py:38-57).
 
 from scaletorch_tpu.ops.flash_attention import flash_attention  # noqa: F401
 from scaletorch_tpu.ops.pallas.grouped_mlp import grouped_swiglu_mlp  # noqa: F401
+from scaletorch_tpu.ops.quantized_collectives import (  # noqa: F401
+    dequantize_blockwise,
+    quantize_blockwise,
+    quantized_pmean,
+    quantized_pmean_tree,
+)
 from scaletorch_tpu.ops.ring_attention import ring_attention  # noqa: F401
 from scaletorch_tpu.ops.ulysses import ulysses_attention  # noqa: F401

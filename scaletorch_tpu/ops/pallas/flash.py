@@ -125,9 +125,11 @@ def _semantics(*dims):
     sequential reduction dims that carry scratch accumulators). Declaring
     them lets Mosaic schedule DMAs/compute across iterations instead of
     assuming every dim may carry state."""
+    from scaletorch_tpu.compat import pallas_tpu_compiler_params
+
     m = {"p": pltpu.PARALLEL, "a": pltpu.ARBITRARY}
-    return pltpu.CompilerParams(
-        dimension_semantics=tuple(m[d] for d in dims))
+    return pallas_tpu_compiler_params(
+        pltpu, dimension_semantics=tuple(m[d] for d in dims))
 
 
 def _flash_forward(q, k, v, causal, scale, bq, bkv, interpret):

@@ -54,9 +54,11 @@ def _pick_block(n: int, preferred: int) -> int:
 def _semantics(*dims):
     """'p' = parallel grid dim, 'a' = arbitrary (sequential reduction dim
     carrying a scratch accumulator) — see ops/pallas/flash.py."""
+    from scaletorch_tpu.compat import pallas_tpu_compiler_params
+
     m = {"p": pltpu.PARALLEL, "a": pltpu.ARBITRARY}
-    return pltpu.CompilerParams(
-        dimension_semantics=tuple(m[d] for d in dims))
+    return pallas_tpu_compiler_params(
+        pltpu, dimension_semantics=tuple(m[d] for d in dims))
 
 
 def _kernel(count_ref, x_ref, wg_ref, wu_ref, wd_ref, o_ref, acc_sc,

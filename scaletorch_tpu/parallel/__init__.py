@@ -28,6 +28,13 @@ from scaletorch_tpu.parallel.fsdp import (  # noqa: F401
     setup_fsdp,
     shard_params_fsdp,
 )
+from scaletorch_tpu.parallel.cp_select import (  # noqa: F401
+    CPChoice,
+    cp_cross_host_hops,
+    resolve_cp_backend,
+    ring_wire_bytes,
+    ulysses_wire_bytes,
+)
 from scaletorch_tpu.parallel.expert_parallel import (  # noqa: F401
     combine_routed,
     dispatch_routed,

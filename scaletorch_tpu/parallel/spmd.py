@@ -59,17 +59,68 @@ def opt_state_specs(tx: optax.GradientTransformation, params: Any, param_specs: 
     )
 
 
+def spec_axes(spec) -> Tuple[str, ...]:
+    """Flattened mesh-axis names a PartitionSpec shards over (tuples in a
+    spec entry — e.g. P(('dp','ep'), None) — are expanded)."""
+    names: list = []
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            names.extend(a for a in entry if a)
+        else:
+            names.append(entry)
+    return tuple(names)
+
+
+def leaf_spec_list(params: Any, p_specs: Any) -> list:
+    """Per-leaf PartitionSpec, aligned with ``tree_leaves(params)``.
+
+    Static (spec-derived) leaf metadata rather than ``jax.typeof(...).vma``
+    reflection: the specs are ground truth for how each leaf is sharded,
+    and — unlike vma — they exist on pre-VMA jax builds too (compat.py).
+
+    Unlike shard_map's in_specs, which also accepts pytree PREFIXES,
+    this alignment needs one PartitionSpec per param leaf — a prefix (or
+    a bare None entry, which tree_leaves silently drops) would misalign
+    every zip over the flattened trees, so it is rejected loudly."""
+    spec_leaves = jax.tree_util.tree_leaves(
+        p_specs, is_leaf=lambda x: isinstance(x, P)
+    )
+    n_params = len(jax.tree_util.tree_leaves(params))
+    if len(spec_leaves) != n_params:
+        raise ValueError(
+            f"param_specs must carry exactly one PartitionSpec per param "
+            f"leaf (got {len(spec_leaves)} specs for {n_params} leaves); "
+            "pytree-prefix specs and None entries are not supported here "
+            "— expand them with jax.tree.map(lambda _, s: s, params, "
+            "specs) first"
+        )
+    return spec_leaves
+
+
 def _leaf_sqsum_partitioned(
-    grads: Any, shard_axes: Tuple[str, ...] = ("tp", "pp")
+    grads: Any,
+    shard_axes: Tuple[str, ...] = ("tp", "pp"),
+    leaf_axes: Optional[list] = None,
 ) -> jax.Array:
     """Global sum of squares over a gradient tree whose leaves are a mix of
     model-sharded (varying over tp and/or pp) and replicated arrays.
     Each leaf's partial square-sum is psum'd over exactly the shard axes it
-    varies over, so every element is counted once."""
+    varies over, so every element is counted once. ``leaf_axes`` (aligned
+    with tree_leaves) supplies each leaf's sharded axes statically; when
+    omitted they are read from the VMA type (new-jax only)."""
     groups: Dict[Tuple[str, ...], jax.Array] = {}
-    for g in jax.tree_util.tree_leaves(grads):
+    leaves = jax.tree_util.tree_leaves(grads)
+    if leaf_axes is None:
+        leaf_axes = [
+            tuple(a for a in shard_axes
+                  if a in getattr(jax.typeof(g), "vma", ()))
+            for g in leaves
+        ]
+    for g, axes in zip(leaves, leaf_axes):
         s = jnp.sum(jnp.square(g.astype(jnp.float32)))
-        axes = tuple(a for a in shard_axes if a in getattr(jax.typeof(g), "vma", ()))
+        axes = tuple(a for a in shard_axes if a in axes)
         groups[axes] = groups.get(axes, jnp.float32(0.0)) + s
     total = jnp.float32(0.0)
     for axes, s in groups.items():
@@ -77,19 +128,26 @@ def _leaf_sqsum_partitioned(
     return total
 
 
-def global_grad_norm(grads: Any, shard_axes: Tuple[str, ...] = ("tp", "pp")):
+def global_grad_norm(
+    grads: Any,
+    shard_axes: Tuple[str, ...] = ("tp", "pp"),
+    leaf_axes: Optional[list] = None,
+):
     if isinstance(shard_axes, str):  # tolerate single-axis callers
         shard_axes = (shard_axes,)
-    return jnp.sqrt(_leaf_sqsum_partitioned(grads, shard_axes))
+    return jnp.sqrt(_leaf_sqsum_partitioned(grads, shard_axes, leaf_axes))
 
 
 def clip_by_global_norm(
-    grads: Any, max_norm: float, shard_axes: Tuple[str, ...] = ("tp", "pp")
+    grads: Any,
+    max_norm: float,
+    shard_axes: Tuple[str, ...] = ("tp", "pp"),
+    leaf_axes: Optional[list] = None,
 ):
     """Returns (clipped_grads, pre_clip_norm)."""
     if isinstance(shard_axes, str):
         shard_axes = (shard_axes,)
-    norm = global_grad_norm(grads, shard_axes)
+    norm = global_grad_norm(grads, shard_axes, leaf_axes)
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(norm, 1e-12))
     return jax.tree.map(lambda g: g * scale, grads), norm
 
@@ -350,6 +408,9 @@ def make_spmd_train_step(
     custom_pipeline_has_aux: bool = False,
     pp_vpp: int = 1,
     nonfinite_guard: bool = True,
+    grad_allreduce_dtype: str = "fp32",
+    grad_allreduce_axis: str = "dp",
+    grad_allreduce_block_size: int = 256,
 ) -> Tuple[Callable, Any, Any]:
     """Build the jitted 5D train step.
 
@@ -380,6 +441,17 @@ def make_spmd_train_step(
     the rejection is mesh-consistent by construction (the resilience
     layer's in-step half; host-side policy lives in
     scaletorch_tpu/resilience.py).
+
+    ``grad_allreduce_dtype`` ('fp32' | 'bf16' | 'int8'): wire format of
+    the gradient mean over ``grad_allreduce_axis`` (default 'dp' — the
+    axis that crosses DCN on multi-host meshes). The other data axes
+    (cp, ep) and the model-axis psums stay fp32: they ride ICI, where
+    bandwidth is not the binding constraint. 'int8' is the block-scaled
+    quantized all-reduce (ops/quantized_collectives.py, ~4x fewer bytes);
+    'bf16' halves the bytes with a plain cast. The reduction over the
+    quantized axis runs LAST, on gradients that are already cp/ep-meaned
+    and tp/pp-complete, so the quantization error is applied exactly
+    once to the final value.
     """
     use_pp = mm.pp > 1
     if (use_pp and custom_pipeline_loss is None
@@ -459,6 +531,40 @@ def make_spmd_train_step(
     # the vma bookkeeping must line up.
     all_axes = DATA_AXES + ("ep",) + (("tp", "pp") if use_pp else ("tp",))
 
+    # Static per-leaf sharding metadata from the specs (not from VMA
+    # reflection — leaf_spec_list docstring): which model axes each leaf
+    # is sharded over drives the reduction below and the global norm.
+    shard_axes = ("tp", "pp") if use_pp else ("tp",)
+    leaf_shard_axes = [
+        spec_axes(s) for s in leaf_spec_list(params, p_specs)
+    ]
+    # Per leaf: the model axes it is NOT sharded over — its gradient
+    # shards are partial sums needing a psum over exactly those axes.
+    rep_axes = [
+        tuple(a for a in shard_axes if a not in ax) for ax in leaf_shard_axes
+    ]
+    # Expert-sharded leaves (varying over ep): their backward
+    # all-to-all already summed every ep rank's loss contribution, so
+    # they take a 1/ep scale instead of the data-axis pmean over ep.
+    ep_sharded = ["ep" in ax for ax in leaf_shard_axes]
+
+    if grad_allreduce_dtype not in ("fp32", "bf16", "int8"):
+        raise ValueError(
+            "grad_allreduce_dtype must be 'fp32', 'bf16' or 'int8', got "
+            f"{grad_allreduce_dtype!r}"
+        )
+    if grad_allreduce_axis not in DATA_AXES:
+        raise ValueError(
+            f"grad_allreduce_axis must be one of {DATA_AXES} (the "
+            f"gradient-mean group), got {grad_allreduce_axis!r}"
+        )
+    # Quantizing a size-1 axis would pay two quantization errors to move
+    # zero bytes; silently run the fp32 path instead.
+    quant_dtype = (
+        grad_allreduce_dtype
+        if mm.axis_size(grad_allreduce_axis) > 1 else "fp32"
+    )
+
     def step(p, opt_state, batch):
         accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
 
@@ -470,19 +576,6 @@ def make_spmd_train_step(
         # the no_sync + single-bucket-flush contract
         # (reference data_parallel.py:46-68, bucket.py:58-77).
         vma_of = lambda x: getattr(jax.typeof(x), "vma", ())  # noqa: E731
-        shard_axes = ("tp", "pp") if use_pp else ("tp",)
-        # Per leaf: the model axes it is NOT sharded over — its gradient
-        # shards are partial sums needing a psum over exactly those axes.
-        rep_axes = [
-            tuple(a for a in shard_axes if a not in vma_of(x))
-            for x in jax.tree_util.tree_leaves(p)
-        ]
-        # Expert-sharded leaves (varying over ep): their backward
-        # all-to-all already summed every ep rank's loss contribution, so
-        # they take a 1/ep scale instead of the data-axis pmean over ep.
-        ep_sharded = [
-            "ep" in vma_of(x) for x in jax.tree_util.tree_leaves(p)
-        ]
         from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
 
         p_v = jax.tree.map(lambda x: pvary_missing(x, all_axes), p)
@@ -628,17 +721,52 @@ def make_spmd_train_step(
         # g-function all-reduce, folded into the same single reduction
         # point; pp-replicated leaves — embed/norm/head — are psum'd over
         # pp because only their owning stage produced a nonzero grad).
+        #
+        # With a non-fp32 grad_allreduce_dtype the mean SPLITS: the
+        # ICI-cheap axes reduce per-leaf in fp32 first, then the
+        # bandwidth-bound grad_allreduce_axis (DCN on multi-host) reduces
+        # LAST over the whole tree in the quantized wire format — one
+        # fused collective pair per vma-homogeneous leaf group
+        # (ops/quantized_collectives.py).
         leaves, treedef = jax.tree_util.tree_flatten(grads)
         data_axes_full = DATA_AXES + ("ep",)
+        q_axis = grad_allreduce_axis
         reduced = []
         for g, axes, is_ep in zip(leaves, rep_axes, ep_sharded):
             if is_ep:
-                g = jax.lax.pmean(g, DATA_AXES) / mm.ep
+                fp_axes = tuple(
+                    a for a in DATA_AXES
+                    if quant_dtype == "fp32" or a != q_axis)
+                if fp_axes:
+                    g = jax.lax.pmean(g, fp_axes)
+                g = g / mm.ep
             else:
-                g = jax.lax.pmean(g, data_axes_full)
+                fp_axes = tuple(
+                    a for a in data_axes_full
+                    if quant_dtype == "fp32" or a != q_axis)
+                g = jax.lax.pmean(g, fp_axes)
             if axes:
                 g = jax.lax.psum(g, axes)
             reduced.append(g)
+        if quant_dtype != "fp32":
+            from scaletorch_tpu.ops.quantized_collectives import (
+                quantized_pmean_tree,
+            )
+
+            # Group leaves by their (static) model-axis sharding so each
+            # fused flatten+concat mixes only vma-identical arrays, then
+            # run the quantized mean over q_axis per group.
+            by_sig: Dict[Tuple[str, ...], list] = {}
+            for i, ax in enumerate(leaf_shard_axes):
+                by_sig.setdefault(tuple(sorted(ax)), []).append(i)
+            for sig, idxs in by_sig.items():
+                group = [reduced[i] for i in idxs]
+                group = quantized_pmean_tree(
+                    group, q_axis, dtype=quant_dtype,
+                    block_size=grad_allreduce_block_size,
+                )
+                for i, g in zip(idxs, group):
+                    reduced[i] = g
         grads = jax.tree_util.tree_unflatten(treedef, reduced)
         loss = jax.lax.pmean(loss, all_axes)
         extras = jax.tree.map(
@@ -648,9 +776,10 @@ def make_spmd_train_step(
 
         norm_axes = shard_axes + ("ep",)
         if max_grad_norm and max_grad_norm > 0:
-            grads, grad_norm = clip_by_global_norm(grads, max_grad_norm, norm_axes)
+            grads, grad_norm = clip_by_global_norm(
+                grads, max_grad_norm, norm_axes, leaf_shard_axes)
         else:
-            grad_norm = global_grad_norm(grads, norm_axes)
+            grad_norm = global_grad_norm(grads, norm_axes, leaf_shard_axes)
 
         # Hand the optimizer param-dtype gradients: reduction + clipping
         # above ran in fp32 regardless, but bf16 master params (torch-parity

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from scaletorch_tpu.compat import psum_replicated_ct
+
 
 def axis_rank(axis: str) -> jax.Array:
     return jax.lax.axis_index(axis)
@@ -68,8 +70,13 @@ def copy_to_tensor_parallel_region(x: jax.Array, axis: str = "tp") -> jax.Array:
 
 
 def reduce_from_tensor_parallel_region(x: jax.Array, axis: str = "tp") -> jax.Array:
-    """All-reduce forward / identity backward (reference tp_comms.py:117-166)."""
-    return jax.lax.psum(x, axis)
+    """All-reduce forward / identity backward (reference tp_comms.py:117-166).
+
+    ``psum_replicated_ct`` rather than raw ``psum``: on pre-VMA jax the
+    identity backward must be stated as a custom_vjp or the in-body
+    transpose inflates upstream gradients by the axis size
+    (compat.py docstring); on VMA builds it IS ``jax.lax.psum``."""
+    return psum_replicated_ct(x, axis)
 
 
 def gather_from_tensor_parallel_region(x: jax.Array, axis: str = "tp") -> jax.Array:
@@ -136,7 +143,7 @@ def vocab_parallel_embedding(
     emb = jnp.where(in_shard[..., None], emb, 0)
     if reduce == "none":
         return emb
-    return jax.lax.psum(emb, axis)
+    return psum_replicated_ct(emb, axis)
 
 
 def _vocab_parallel_token_stats(
@@ -162,7 +169,7 @@ def _vocab_parallel_token_stats(
     global_max = jax.lax.pmax(local_max, axis) if axis else local_max
     sumexp = jnp.sum(jnp.exp(logits32 - global_max[..., None]), axis=-1)
     if axis:
-        sumexp = jax.lax.psum(sumexp, axis)
+        sumexp = psum_replicated_ct(sumexp, axis)
     logz = global_max + jnp.log(sumexp)
 
     mask = targets != ignore_index
@@ -172,7 +179,7 @@ def _vocab_parallel_token_stats(
     gold = jnp.take_along_axis(logits32, local_t[..., None], axis=-1)[..., 0]
     gold = jnp.where(in_shard, gold, 0.0)
     if axis:
-        gold = jax.lax.psum(gold, axis)
+        gold = psum_replicated_ct(gold, axis)
     nll = (logz - gold) * mask
     return jnp.sum(nll), jnp.sum(mask).astype(jnp.float32)
 

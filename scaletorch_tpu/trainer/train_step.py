@@ -71,10 +71,21 @@ def make_loss_fn(forward: Callable, cfg, *, attention_backend: str,
 
 
 def accumulate_gradients(
-    loss_fn: Callable, params: Any, batch: Batch
+    loss_fn: Callable, params: Any, batch: Batch, *, pvary_axes=None
 ) -> Tuple[jax.Array, Any]:
-    """Mean loss + mean grads over the leading accumulation axis via scan."""
+    """Mean loss + mean grads over the leading accumulation axis via scan.
+
+    ``pvary_axes``: when running inside a ``shard_map`` over those mesh
+    axes (the quantized-allreduce step), params and the scan carry are
+    marked varying first so the VMA bookkeeping lines up; identity
+    outside shard_map and on pre-VMA jax (compat.py)."""
     accum = jax.tree_util.tree_leaves(batch)[0].shape[0]
+    if pvary_axes:
+        from scaletorch_tpu.parallel.tensor_parallel import pvary_missing
+    else:
+        def pvary_missing(x, _axes):
+            return x
+    params = jax.tree.map(lambda x: pvary_missing(x, pvary_axes), params)
 
     def micro_step(carry, mb):
         grads_acc, loss_acc = carry
@@ -82,8 +93,12 @@ def accumulate_gradients(
         grads_acc = jax.tree.map(jnp.add, grads_acc, grads)
         return (grads_acc, loss_acc + loss), None
 
-    zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-    (grads, loss_sum), _ = jax.lax.scan(micro_step, (zeros, jnp.float32(0.0)), batch)
+    zeros = jax.tree.map(
+        lambda p: pvary_missing(jnp.zeros(p.shape, jnp.float32), pvary_axes),
+        params,
+    )
+    l0 = pvary_missing(jnp.float32(0.0), pvary_axes)
+    (grads, loss_sum), _ = jax.lax.scan(micro_step, (zeros, l0), batch)
     scale = 1.0 / accum
     grads = jax.tree.map(lambda g: g * scale, grads)
     return loss_sum * scale, grads
@@ -132,6 +147,8 @@ def make_train_step(
     mesh=None,
     data_spec=None,
     nonfinite_guard: bool = True,
+    grad_allreduce_dtype: str = "fp32",
+    grad_allreduce_block_size: int = 256,
 ) -> Callable:
     """Build the jitted step: (params, opt_state, batch) ->
     (params, opt_state, metrics).
@@ -145,6 +162,16 @@ def make_train_step(
     leaves params and optimizer state untouched and reports
     ``update_skipped=1`` in the metrics, so one poisoned batch cannot
     destroy the run between checkpoints.
+
+    ``grad_allreduce_dtype`` ('fp32' | 'bf16' | 'int8'): wire format of
+    the data-parallel gradient mean. fp32 keeps this the fully
+    declarative step (XLA derives the reduction from shardings). bf16 /
+    int8 need the reduction to be an *explicit* collective, so the
+    grad computation is wrapped in a ``shard_map`` over ``data_spec``'s
+    axes with params REPLICATED — the plain-DP regime. The FSDP caller
+    (params sharded over the data axis) must keep fp32: quantizing
+    GSPMD's derived reduce-scatters is the SPMD path's job
+    (parallel/spmd.py), not this step's.
     """
     loss_fn = make_loss_fn(
         forward,
@@ -152,6 +179,27 @@ def make_train_step(
         attention_backend=attention_backend,
         gradient_checkpointing=gradient_checkpointing,
     )
+
+    if grad_allreduce_dtype not in ("fp32", "bf16", "int8"):
+        raise ValueError(
+            "grad_allreduce_dtype must be 'fp32', 'bf16' or 'int8', got "
+            f"{grad_allreduce_dtype!r}"
+        )
+    if grad_allreduce_dtype != "fp32":
+        if mesh is None or data_spec is None:
+            raise ValueError(
+                "grad_allreduce_dtype="
+                f"{grad_allreduce_dtype!r} needs mesh + data_spec: the "
+                "quantized mean is an explicit collective over the data "
+                "axes (with fp32 there is no explicit reduction to "
+                "quantize)"
+            )
+        return _make_quantized_dp_step(
+            loss_fn, optimizer, mesh, data_spec,
+            dtype=grad_allreduce_dtype,
+            block_size=grad_allreduce_block_size,
+            donate=donate, nonfinite_guard=nonfinite_guard,
+        )
 
     def train_step(params, opt_state, batch):
         loss, grads = accumulate_gradients(loss_fn, params, batch)
@@ -182,6 +230,81 @@ def make_train_step(
             in_shardings=(None, None, batch_sharding),
         )
     return jax.jit(train_step, donate_argnums=donate_argnums)
+
+
+def _make_quantized_dp_step(
+    loss_fn: Callable,
+    optimizer: optax.GradientTransformation,
+    mesh,
+    data_spec,
+    *,
+    dtype: str,
+    block_size: int,
+    donate: bool,
+    nonfinite_guard: bool,
+) -> Callable:
+    """The bf16/int8 variant of the declarative step: grad accumulation
+    runs per data shard inside a ``shard_map`` (params replicated, batch
+    per ``data_spec``) and the single per-step gradient synchronisation is
+    the explicit quantized mean (ops/quantized_collectives.py) instead of
+    XLA's derived fp32 all-reduce. Optimizer update, clipping semantics
+    and the non-finite guard are identical to the fp32 step and run on
+    the replicated (post-reduction) gradients outside the shard_map.
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from scaletorch_tpu.ops.quantized_collectives import (
+        quantized_pmean_tree,
+    )
+    from scaletorch_tpu.parallel.spmd import spec_axes
+
+    axes = spec_axes(data_spec)
+    if not axes:
+        raise ValueError(
+            f"data_spec {data_spec} names no mesh axes — nothing to "
+            "reduce over"
+        )
+
+    def local_grads(p, batch):
+        loss, grads = accumulate_gradients(
+            loss_fn, p, batch, pvary_axes=axes)
+        # THE gradient synchronisation, in the quantized wire format; its
+        # all-gather leg leaves every rank with the identical fp32 mean.
+        grads = quantized_pmean_tree(
+            grads, axes if len(axes) > 1 else axes[0],
+            dtype=dtype, block_size=block_size,
+        )
+        return jax.lax.pmean(loss, axes), grads
+
+    sharded_grads = jax.shard_map(
+        local_grads,
+        mesh=mesh,
+        in_specs=(P(), data_spec),
+        out_specs=(P(), P()),
+    )
+
+    def train_step(params, opt_state, batch):
+        loss, grads = sharded_grads(params, batch)
+        grad_norm = optax.global_norm(grads)
+        grads = jax.tree.map(lambda g, w: g.astype(w.dtype), grads, params)
+        metrics = {"loss": loss, "grad_norm": grad_norm}
+        if nonfinite_guard:
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            params, opt_state, skipped = guarded_update(
+                optimizer, params, opt_state, grads, ok
+            )
+            metrics["update_skipped"] = skipped
+        else:
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    batch_sharding = NamedSharding(mesh, data_spec)
+    return jax.jit(
+        train_step,
+        donate_argnums=(0, 1) if donate else (),
+        in_shardings=(None, None, batch_sharding),
+    )
 
 
 def make_eval_step(forward: Callable, cfg, *, attention_backend: str = "sdpa"):

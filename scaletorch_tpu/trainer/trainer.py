@@ -247,9 +247,32 @@ class Trainer:
                 )
             self.logger.info(
                 f"pp_virtual_stages auto-resolved to {self._pp_vpp}")
-        self.attention_backend = resolve_attention_backend(
-            cfg.attention_backend, context_parallel=cfg.context_parallel_size > 1
-        )
+        if cfg.context_parallel_size > 1 and cfg.attention_backend == "auto":
+            # Topology-aware CP auto-selection (parallel/cp_select.py): the
+            # hand-tuned ring/zigzag/ulysses table computed from the real
+            # mesh (DCN hops along the cp axis), the model's head geometry
+            # and the sequence length, attested by AOT_CP_CROSSOVER.json.
+            from scaletorch_tpu.parallel.cp_select import resolve_cp_backend
+
+            choice = resolve_cp_backend(
+                "auto",
+                self.mm.mesh,
+                cp=cfg.context_parallel_size,
+                num_q_heads=self.model_cfg.num_attention_heads,
+                num_kv_heads=self.model_cfg.num_key_value_heads,
+                seq_len=cfg.sequence_length,
+                layout=cfg.cp_layout,
+            )
+            self.attention_backend = choice.backend
+            self.logger.info(
+                f"cp backend auto-selected: {choice.backend} "
+                f"(layout {choice.layout}) — {choice.reason}"
+            )
+        else:
+            self.attention_backend = resolve_attention_backend(
+                cfg.attention_backend,
+                context_parallel=cfg.context_parallel_size > 1,
+            )
         if (cfg.context_parallel_size > 1
                 and self.attention_backend not in ("ring", "ulysses")):
             # A full-sequence backend on cp-sharded activations would silently
@@ -268,6 +291,18 @@ class Trainer:
             cfg.context_parallel_size > 1 and cfg.cp_layout == "zigzag"
             and self.attention_backend == "ring"
         )
+        if (self._zigzag_cp
+                and cfg.sequence_length % (2 * cfg.context_parallel_size)):
+            # The config-time check defers this for attention_backend
+            # 'auto' (it cannot know the resolver's verdict); now that
+            # the backend is settled as ring+zigzag, enforce it with the
+            # same remedy message.
+            raise ValueError(
+                f"cp_layout='zigzag' needs sequence_length "
+                f"{cfg.sequence_length} divisible by 2*cp "
+                f"({2 * cfg.context_parallel_size}); use cp_layout="
+                f"'contiguous' for odd stripe splits"
+            )
         if (cfg.context_parallel_size > 1 and cfg.cp_layout == "zigzag"
                 and self.attention_backend == "ulysses"):
             self.logger.info(
@@ -442,6 +477,9 @@ class Trainer:
             head_weight_fn=head_weight_fn,
             model_family="qwen3_moe" if is_moe else "llama",
             nonfinite_guard=cfg.nonfinite_guard,
+            grad_allreduce_dtype=cfg.grad_allreduce_dtype,
+            grad_allreduce_axis=cfg.grad_allreduce_axis,
+            grad_allreduce_block_size=cfg.grad_allreduce_block_size,
         )
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)

@@ -1,0 +1,355 @@
+"""Quantized gradient all-reduce: numerics, determinism, wire bytes.
+
+Three contracts attested here (ISSUE 6 acceptance):
+  * block round-trip error is bounded by half a quantization step;
+  * a REAL train step's int8-reduced gradients match fp32 to cosine
+    >= 0.999, its short-run loss curve matches within tolerance, and no
+    update is skipped;
+  * the compiled HLO moves >= ~3x fewer collective wire bytes on the dp
+    axis than the fp32 step (the point of the whole exercise).
+
+All collectives run for real on the 8 virtual CPU devices (conftest.py),
+never mocked.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from scaletorch_tpu.ops.quantized_collectives import (
+    collective_wire_bytes,
+    dequantize_blockwise,
+    quantize_blockwise,
+    quantized_pmean,
+    quantized_pmean_tree,
+)
+from scaletorch_tpu.parallel.mesh import MeshManager
+
+BLOCK = 64
+
+
+class TestBlockQuantization:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.default_rng(0)
+        # mix of scales per block, incl. huge + tiny magnitudes
+        x = jnp.asarray(
+            rng.standard_normal(16 * BLOCK)
+            * np.repeat(10.0 ** rng.integers(-4, 4, 16), BLOCK),
+            jnp.float32,
+        )
+        q, s = quantize_blockwise(x, BLOCK)
+        assert q.dtype == jnp.int8 and s.dtype == jnp.float32
+        err = np.abs(np.asarray(dequantize_blockwise(q, s) - x))
+        bound = np.repeat(np.asarray(s), BLOCK) * 0.5
+        assert np.all(err <= bound + 1e-12)
+
+    def test_zero_block_safe(self):
+        x = jnp.zeros(2 * BLOCK, jnp.float32)
+        q, s = quantize_blockwise(x, BLOCK)
+        assert np.all(np.asarray(dequantize_blockwise(q, s)) == 0.0)
+        assert np.all(np.isfinite(np.asarray(s)))
+
+    def test_unpadded_input_rejected(self):
+        with pytest.raises(ValueError, match="multiple of block_size"):
+            quantize_blockwise(jnp.zeros(BLOCK + 1, jnp.float32), BLOCK)
+
+
+def _run_pmean(mm, xs, block=BLOCK):
+    """xs: [dp, N] — row r is rank r's local value; returns [dp, N]."""
+
+    def body(v):
+        return quantized_pmean(v.reshape(-1), "dp", block_size=block)[None]
+
+    return np.asarray(
+        jax.jit(
+            jax.shard_map(
+                body, mesh=mm.mesh, in_specs=P("dp", None),
+                out_specs=P("dp", None),
+            )
+        )(xs)
+    )
+
+
+class TestQuantizedPmean:
+    def test_matches_fp32_mean(self, devices8):
+        mm = MeshManager(dp=4, devices=devices8[:4])
+        rng = np.random.default_rng(1)
+        xs = jnp.asarray(rng.standard_normal((4, 1000)), jnp.float32)
+        got = _run_pmean(mm, xs)
+        ref = np.mean(np.asarray(xs), axis=0)
+        # every rank holds the identical reduced value (the all-gather leg)
+        for r in range(1, 4):
+            assert np.array_equal(got[0], got[r])
+        cos = np.dot(got[0], ref) / (
+            np.linalg.norm(got[0]) * np.linalg.norm(ref)
+        )
+        assert cos >= 0.999
+        # elementwise: two quantizations, each bounded by its block scale
+        assert np.abs(got[0] - ref).max() < 0.05
+
+    def test_deterministic_across_device_placements(self, devices8):
+        """Same logical shards -> bit-identical result no matter which
+        physical devices back the dp ranks (the virtual-mesh stand-in for
+        'same answer at any host/process layout')."""
+        rng = np.random.default_rng(2)
+        xs = jnp.asarray(rng.standard_normal((4, 513)), jnp.float32)
+        a = _run_pmean(MeshManager(dp=4, devices=devices8[:4]), xs)
+        b = _run_pmean(MeshManager(dp=4, devices=devices8[4:][::-1]), xs)
+        assert np.array_equal(a, b)
+
+    def test_repeated_runs_bitwise_identical(self, devices8):
+        mm = MeshManager(dp=4, devices=devices8[:4])
+        rng = np.random.default_rng(3)
+        xs = jnp.asarray(rng.standard_normal((4, 257)), jnp.float32)
+        assert np.array_equal(_run_pmean(mm, xs), _run_pmean(mm, xs))
+
+    def test_small_leaf_keeps_signal_next_to_large_leaf(self, devices8):
+        """Leaves are padded to block boundaries before the fused concat:
+        a tiny-magnitude leaf must NOT share an absmax block with a
+        large-magnitude neighbor (which would quantize it to zero —
+        invisible in aggregate cosine, fatal for that parameter)."""
+        mm = MeshManager(dp=4, devices=devices8[:4])
+        rng = np.random.default_rng(5)
+        tree = {
+            "big": jnp.asarray(rng.standard_normal((4, 3 * BLOCK + 7)),
+                               jnp.float32),
+            "small": jnp.asarray(
+                rng.standard_normal((4, BLOCK // 2)) * 1e-4, jnp.float32),
+        }
+
+        def body(t):
+            local = {k: v[0] for k, v in t.items()}
+            out = quantized_pmean_tree(local, "dp", block_size=BLOCK)
+            return {k: v[None] for k, v in out.items()}
+
+        got = jax.jit(
+            jax.shard_map(
+                body, mesh=mm.mesh, in_specs=P("dp"), out_specs=P("dp"),
+            )
+        )(tree)
+        ref = np.mean(np.asarray(tree["small"]), axis=0)
+        small = np.asarray(got["small"])[0]
+        # relative accuracy appropriate to the SMALL leaf's own scale
+        cos = np.dot(small, ref) / (
+            np.linalg.norm(small) * np.linalg.norm(ref))
+        assert cos >= 0.999, cos
+        assert np.abs(small - ref).max() < 1e-5
+
+    def test_tree_fused_matches_per_leaf(self, devices8):
+        mm = MeshManager(dp=4, devices=devices8[:4])
+        rng = np.random.default_rng(4)
+        tree = {
+            "w": jnp.asarray(rng.standard_normal((4, 8, 9)), jnp.float32),
+            "b": jnp.asarray(rng.standard_normal((4, 33)), jnp.float32),
+        }
+
+        def body(t):
+            local = {k: v[0] for k, v in t.items()}
+            out = quantized_pmean_tree(local, "dp", block_size=BLOCK)
+            return {k: v[None] for k, v in out.items()}
+
+        got = jax.jit(
+            jax.shard_map(
+                body, mesh=mm.mesh, in_specs=P("dp"), out_specs=P("dp"),
+            )
+        )(tree)
+        for k, v in tree.items():
+            ref = np.mean(np.asarray(v), axis=0)
+            assert np.abs(np.asarray(got[k])[0] - ref).max() < 0.05, k
+
+
+# ---------------------------------------------------------------------------
+# Real-train-step attestation (shared tiny model, compiled once per dtype)
+# ---------------------------------------------------------------------------
+def _tiny_cfg(dtype, **over):
+    from scaletorch_tpu.config import ScaleTorchTPUArguments
+
+    kw = dict(
+        model_type="llama", vocab_size=512, hidden_size=64,
+        intermediate_size=128, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, head_dim=16, max_position_embeddings=256,
+        sequence_length=64, micro_batch_size=2, data_parallel_size=4,
+        tensor_parallel_size=2, synthetic_data=True, max_grad_norm=1.0,
+        grad_allreduce_dtype=dtype, learning_rate=1e-3,
+    )
+    kw.update(over)
+    return ScaleTorchTPUArguments(**kw)
+
+
+def _build_spmd(dtype, tx=None, dp=4, tp=2):
+    import optax
+
+    from scaletorch_tpu.models import llama
+    from scaletorch_tpu.parallel.spmd import make_spmd_train_step, shard_params
+    from scaletorch_tpu.trainer.trainer import build_model_config
+
+    cfg = _tiny_cfg(dtype, data_parallel_size=dp, tensor_parallel_size=tp)
+    model_cfg = build_model_config(cfg)
+    mm = MeshManager(dp=dp, tp=tp)
+    params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+    tx = tx if tx is not None else optax.adamw(1e-3)
+    step_fn, p_specs, o_specs = make_spmd_train_step(
+        mm, llama.forward, model_cfg, tx, params, max_grad_norm=1.0,
+        grad_allreduce_dtype=dtype, donate=False,
+    )
+    p = shard_params(mm, params, p_specs)
+    o = shard_params(mm, tx.init(params), o_specs)
+    return step_fn, p, o, params, tx
+
+
+def _batch(seed=0, accum=1, rows=8, seq=64, vocab=512):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, size=(accum, rows, seq))
+    return {
+        "input_ids": jnp.asarray(ids, jnp.int32),
+        "target_ids": jnp.asarray(np.roll(ids, -1, axis=-1), jnp.int32),
+        "position_ids": jnp.broadcast_to(
+            jnp.arange(seq, dtype=jnp.int32)[None], (accum, seq)
+        ),
+    }
+
+
+@pytest.fixture(scope="module")
+def sgd_step_pair():
+    """fp32 + int8 SPMD steps with lr-1 SGD, so one step's param delta IS
+    the (clipped) gradient — the grad cosine-similarity probe."""
+    import optax
+
+    pair = {}
+    for dtype in ("fp32", "int8"):
+        pair[dtype] = _build_spmd(dtype, tx=optax.sgd(1.0))
+    return pair
+
+
+class TestTrainStepParity:
+    def test_grad_cosine_vs_fp32(self, devices8, sgd_step_pair):
+        batch = _batch(7)
+        deltas = {}
+        for dtype, (step_fn, p, o, p_host, _) in sgd_step_pair.items():
+            p2, _, m = step_fn(p, o, batch)
+            delta = jax.tree.map(lambda a, b: np.asarray(a) - np.asarray(b),
+                                 p2, p)
+            deltas[dtype] = np.concatenate(
+                [leaf.ravel() for leaf in jax.tree_util.tree_leaves(delta)]
+            )
+            assert float(m["update_skipped"]) == 0.0
+        a, b = deltas["fp32"], deltas["int8"]
+        cos = np.dot(a, b) / (np.linalg.norm(a) * np.linalg.norm(b))
+        assert cos >= 0.999, cos
+
+    def test_short_run_loss_parity_no_skips(self, devices8):
+        batch = _batch(11)
+        curves = {}
+        for dtype in ("fp32", "int8"):
+            step_fn, p, o, _, _ = _build_spmd(dtype)
+            losses, skipped = [], 0.0
+            for _ in range(5):
+                p, o, m = step_fn(p, o, batch)
+                losses.append(float(m["loss"]))
+                skipped += float(m["update_skipped"])
+            curves[dtype] = losses
+            assert skipped == 0.0, dtype
+        diff = np.abs(np.array(curves["fp32"]) - np.array(curves["int8"]))
+        assert diff.max() < 5e-3, curves
+        # and training actually progressed
+        assert curves["int8"][-1] < curves["int8"][0]
+
+    def test_bf16_mode_runs(self, devices8):
+        step_fn, p, o, _, _ = _build_spmd("bf16")
+        p, o, m = step_fn(p, o, _batch(13))
+        assert np.isfinite(float(m["loss"]))
+        assert float(m["update_skipped"]) == 0.0
+
+
+class TestWireBytes:
+    def test_int8_dp_wire_bytes_3x_lower(self, devices8):
+        """Compiled-HLO attestation: on a pure-dp mesh every nontrivial
+        gradient collective IS the dp all-reduce; int8 must move >= ~3x
+        fewer wire bytes than fp32 (ISSUE 6 acceptance — measured ~4x
+        minus the scale overhead and the shared scalar reductions)."""
+        import optax
+
+        from scaletorch_tpu.models import llama
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step
+        from scaletorch_tpu.trainer.trainer import build_model_config
+
+        totals = {}
+        for dtype in ("fp32", "int8"):
+            cfg = _tiny_cfg(dtype, data_parallel_size=8,
+                            tensor_parallel_size=1)
+            model_cfg = build_model_config(cfg)
+            mm = MeshManager(dp=8)
+            params = llama.init_params(jax.random.PRNGKey(0), model_cfg)
+            tx = optax.sgd(1.0)
+            step_fn, _, _ = make_spmd_train_step(
+                mm, llama.forward, model_cfg, tx, params, max_grad_norm=1.0,
+                grad_allreduce_dtype=dtype, donate=False,
+            )
+            batch = {
+                "input_ids": jax.ShapeDtypeStruct((1, 8, 64), jnp.int32),
+                "target_ids": jax.ShapeDtypeStruct((1, 8, 64), jnp.int32),
+                "position_ids": jax.ShapeDtypeStruct((1, 64), jnp.int32),
+            }
+            pshape = jax.eval_shape(lambda: params)
+            oshape = jax.eval_shape(tx.init, params)
+            hlo = step_fn.lower(pshape, oshape, batch).compile().as_text()
+            totals[dtype] = collective_wire_bytes(hlo)
+        ratio = totals["fp32"]["total"] / max(totals["int8"]["total"], 1.0)
+        assert ratio >= 3.0, (ratio, totals)
+        # and the int8 build really carries int8 payloads
+        assert any(dt == "s8" for _, dt in totals["int8"]["by_op"])
+
+
+class TestDeclarativeQuantizedStep:
+    def test_dp_jit_path_parity(self, devices8):
+        """make_train_step's bf16/int8 form (explicit shard_map reduction,
+        replicated params) matches its own fp32 form."""
+        import optax
+
+        from scaletorch_tpu.models import llama
+        from scaletorch_tpu.trainer.train_step import make_train_step
+        from scaletorch_tpu.trainer.trainer import build_model_config
+
+        cfg = _tiny_cfg("fp32", data_parallel_size=1, tensor_parallel_size=1)
+        model_cfg = build_model_config(cfg)
+        mm = MeshManager(dp=8)
+        params = llama.init_params(jax.random.PRNGKey(1), model_cfg)
+        # no position_ids: the declarative step's data_spec applies to
+        # every batch leaf, so all leaves share the [accum, rows, seq] rank
+        # (same contract as the fp32 mesh path).
+        batch = {k: v for k, v in _batch(17, accum=2).items()
+                 if k != "position_ids"}
+        curves = {}
+        for dtype in ("fp32", "int8"):
+            tx = optax.adamw(1e-3)
+            step = make_train_step(
+                llama.forward, model_cfg, tx, attention_backend="sdpa",
+                donate=False, mesh=mm.mesh, data_spec=P(None, "dp", None),
+                grad_allreduce_dtype=dtype,
+            )
+            p, o = params, tx.init(params)
+            losses = []
+            for _ in range(3):
+                p, o, m = step(p, o, batch)
+                losses.append(float(m["loss"]))
+                assert float(m["update_skipped"]) == 0.0
+            curves[dtype] = losses
+        diff = np.abs(np.array(curves["fp32"]) - np.array(curves["int8"]))
+        assert diff.max() < 5e-3, curves
+
+    def test_quantized_needs_mesh(self):
+        import optax
+
+        from scaletorch_tpu.models import llama
+        from scaletorch_tpu.trainer.train_step import make_train_step
+        from scaletorch_tpu.trainer.trainer import build_model_config
+
+        cfg = _tiny_cfg("fp32")
+        model_cfg = build_model_config(cfg)
+        with pytest.raises(ValueError, match="mesh"):
+            make_train_step(
+                llama.forward, model_cfg, optax.sgd(1.0),
+                grad_allreduce_dtype="int8",
+            )

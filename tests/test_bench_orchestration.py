@@ -28,7 +28,11 @@ FAKE_CHILD = textwrap.dedent(
 
     spec = json.loads(os.environ["FAKE_SPEC"])
     ab = os.environ.get("BENCH_MOE_AB") or None
-    if ab:
+    if os.environ.get("BENCH_PROBE") == "1":
+        mode = "probe"
+    elif os.environ.get("BENCH_CPU_FALLBACK") == "1":
+        mode = "cpu_fallback"
+    elif ab:
         mode = "moe_" + ab
     elif os.environ.get("BENCH_PREFLIGHT") == "1":
         mode = "preflight"
@@ -36,9 +40,12 @@ FAKE_CHILD = textwrap.dedent(
         mode = "sdpa_row"
     else:
         mode = "pallas_row"
-    # A/B legs default to a fast ok (speedup 1.0) so specs written for
-    # the attention-path tests keep passing with the dispatch phase on.
-    beh = spec[mode] if not ab else spec.get(mode, "ok")
+    # A/B legs / probe / cpu-fallback default to a fast ok so specs
+    # written for the attention-path tests keep passing.
+    if ab or mode in ("probe", "cpu_fallback"):
+        beh = spec.get(mode, "ok")
+    else:
+        beh = spec[mode]
 
     def mark(stage):
         print(json.dumps({"event": "progress", "stage": stage}),
@@ -56,6 +63,20 @@ FAKE_CHILD = textwrap.dedent(
         print(json.dumps({"metric": mode, "error": "boom"}))
         sys.exit(1)
     mark("done")
+    if mode == "probe":
+        print(json.dumps({
+            "probe": "ok",
+            "platform": spec.get("probe_platform", "tpu"),
+            "device": "fake", "count": 1,
+        }), flush=True)
+        sys.exit(0)
+    if mode == "cpu_fallback":
+        print(json.dumps({
+            "metric": "dense-tiny_seq512_cpu_fallback_tok_s",
+            "value": 700.0, "unit": "tok/s (cpu)", "vs_baseline": 1.0,
+            "cpu_fallback": True, "device": "cpu",
+        }), flush=True)
+        sys.exit(0)
     if ab:
         print(json.dumps({
             "metric": "moe_dispatch_" + ab,
@@ -90,6 +111,10 @@ def fake_bench(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "CHILD_ARGV", [sys.executable, str(child)])
     monkeypatch.chdir(tmp_path)
     monkeypatch.setenv("BENCH_SIGINT_WAITS", "1,1")
+    # These tests exercise the TPU orchestration against fake children;
+    # the phase-0 CPU-fallback gate must stand down (the test env itself
+    # runs JAX_PLATFORMS=cpu, which would otherwise short-circuit it).
+    monkeypatch.setenv("BENCH_FORCE_CPU", "0")
     # 399: phase 1+2 fit (each check needs >=360/180 remaining) but the
     # phase-3 extra-rows loop (needs >=400) stays off unless a test
     # raises the budget explicitly
@@ -371,3 +396,81 @@ def test_last_stage_parser():
     ])
     assert bench._last_stage(err) == "compiled"
     assert bench._last_stage("no markers here") is None
+
+
+# ---------------------------------------------------------------------------
+# Phase-0 CPU fallback (the r03-r05 un-wedger)
+# ---------------------------------------------------------------------------
+def test_dead_relay_skips_backend_init_and_falls_back(fake_bench, capsys,
+                                                      monkeypatch):
+    """A configured-but-unreachable axon relay must route straight to the
+    CPU row — no device child may even attempt a backend init."""
+    monkeypatch.setenv("BENCH_FORCE_CPU", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "127.0.0.1")  # nothing listens
+    fake_bench(cpu_fallback="ok")  # a TPU row would KeyError the fake child
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["cpu_fallback"] is True
+    assert "relay" in line["cpu_fallback_reason"]
+    assert "tok/s" in line["unit"]
+
+
+def test_cpu_platform_env_falls_back_without_probe(fake_bench, capsys,
+                                                   monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_CPU", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    fake_bench(cpu_fallback="ok")
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["cpu_fallback"] is True
+    assert "JAX_PLATFORMS" in line["cpu_fallback_reason"]
+
+
+def test_probe_finding_cpu_platform_falls_back(fake_bench, capsys,
+                                               monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_CPU", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    fake_bench(probe_platform="cpu", cpu_fallback="ok")
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["cpu_fallback"] is True
+    assert "not tpu" in line["cpu_fallback_reason"]
+
+
+def test_probe_timeout_falls_back_within_budget(fake_bench, capsys,
+                                                monkeypatch):
+    """A probe child that hangs at backend init (the dead-tunnel
+    signature) must burn only the probe budget, then go CPU."""
+    monkeypatch.setenv("BENCH_FORCE_CPU", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    monkeypatch.setenv("BENCH_PROBE_BUDGET", "2")
+    fake_bench(probe="hang_at_init", cpu_fallback="ok")
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert line["cpu_fallback"] is True
+    assert "probe" in line["cpu_fallback_reason"]
+
+
+def test_healthy_tpu_probe_proceeds_to_headline(fake_bench, capsys,
+                                                monkeypatch):
+    """With a live TPU behind the probe, the normal headline phases run
+    and the stdout line is the banked MFU row, not the CPU fallback."""
+    monkeypatch.setenv("BENCH_FORCE_CPU", "")
+    monkeypatch.setenv("JAX_PLATFORMS", "")
+    fake_bench(probe_platform="tpu", sdpa_row="ok", sdpa_row_mfu=45.4,
+               preflight="ok", pallas_row="ok", pallas_row_mfu=52.0)
+    assert bench.run_headline() == 0
+    line = _stdout_line(capsys)
+    assert "cpu_fallback" not in line
+    assert line["value"] == 52.0
+
+
+def test_failed_cpu_fallback_still_prints_one_error_line(fake_bench, capsys,
+                                                         monkeypatch):
+    monkeypatch.setenv("BENCH_FORCE_CPU", "1")
+    fake_bench(cpu_fallback="error")
+    assert bench.run_headline() == 1
+    line = _stdout_line(capsys)
+    assert line["metric"] == "error"
+    assert line["cpu_fallback_attempted"] is True

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""AOT wire-byte sweep of the ring-vs-ulysses CP backend crossover.
+
+The CP backend used to be picked from a hand-tuned table
+(docs/long_context.md §4); ``parallel/cp_select.resolve_cp_backend`` now
+computes the choice from topology + geometry. This tool replaces the
+table's guesswork with compiled evidence, the same way
+``tools/aot_dispatch_crossover.py`` attests ``resolve_moe_dispatch``:
+for each (cp, head-geometry, seq) topology it compiles the REAL spmd
+train step on a virtual cp-mesh with BOTH backends and records the
+collective wire bytes XLA actually emits
+(ops/quantized_collectives.collective_wire_bytes ring-cost model), plus
+the resolver's verdict for that topology.
+
+Two modes:
+
+    python tools/aot_cp_crossover.py            # regenerate the JSON
+        [--out AOT_CP_CROSSOVER.json] [--seq 4096]
+
+    python tools/aot_cp_crossover.py --check    # CI smoke (pure python,
+        # no compiles): the checked-in JSON's rows must reproduce under
+        # today's resolver, and the docs-table scenarios must resolve to
+        # their documented answers. Exit 0/1.
+
+Compiles run on virtual CPU devices (``xla_force_host_platform_device_
+count``) in a child process per point — no TPU, no libtpu, no network.
+
+Caveat (same as the MoE tool): wire bytes are compile-time evidence;
+ring hops overlap with per-hop compute where ulysses' all-to-alls are
+exposed, so the resolver demands a >= 2x byte margin before leaving the
+ring on ICI (cp_select.ICI_ULYSSES_BYTE_MARGIN). The on-chip word is
+``tools/bench_cp_compare.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_CHILD_ENV = "_SCALETORCH_TPU_CP_XOVER_CHILD"
+
+# (label, cp, hq, hkv, seq) — the topologies the docs table covered:
+# GQA default (qwen3-ish 16/8), GQA at higher cp, MHA (head-heavy), and
+# an extreme-sequence point.
+TOPOLOGIES = [
+    ("gqa_cp4", 4, 16, 8, 4096),
+    ("gqa_cp8", 8, 16, 8, 4096),
+    ("mha_cp4", 4, 16, 16, 4096),
+    ("gqa_cp4_seq64k", 4, 16, 8, 65536),
+]
+
+# docs/long_context.md §4, one scenario per table row (cross-host has no
+# virtual-mesh compile — process_index is uniform in one process — so it
+# is asserted via the resolver's hop input, not a compiled row).
+DOCS_TABLE_SCENARIOS = [
+    dict(label="default_long_context", cp=4, hq=16, hkv=8, seq=8192,
+         hops=0, expect="ring"),
+    dict(label="many_kv_heads", cp=4, hq=16, hkv=16, seq=8192,
+         hops=0, expect="ulysses"),
+    dict(label="cross_host_dcn", cp=4, hq=16, hkv=8, seq=8192,
+         hops=2, expect="ulysses"),
+    dict(label="extreme_seq", cp=4, hq=16, hkv=8, seq=131072,
+         hops=0, expect="ring"),
+]
+
+
+def _compile_point(cp: int, hq: int, hkv: int, seq: int,
+                   backend: str) -> dict:
+    """Child-side: compile the spmd train step on a cp-only virtual mesh
+    and report its collective wire bytes."""
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={cp}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import scaletorch_tpu  # noqa: F401 — compat backfill on old jax
+    from scaletorch_tpu.config import ScaleTorchTPUArguments
+    from scaletorch_tpu.models import llama
+    from scaletorch_tpu.ops.quantized_collectives import (
+        collective_wire_bytes,
+    )
+    from scaletorch_tpu.parallel.mesh import MeshManager
+    from scaletorch_tpu.parallel.spmd import make_spmd_train_step
+    from scaletorch_tpu.trainer.trainer import build_model_config
+
+    head_dim = 16
+    cfg = ScaleTorchTPUArguments(
+        model_type="llama", vocab_size=512, hidden_size=hq * head_dim,
+        intermediate_size=2 * hq * head_dim, num_hidden_layers=2,
+        num_attention_heads=hq, num_key_value_heads=hkv, head_dim=head_dim,
+        max_position_embeddings=2 * seq, sequence_length=seq,
+        micro_batch_size=1, context_parallel_size=cp, synthetic_data=True,
+        max_grad_norm=1.0, attention_backend=backend,
+        gradient_checkpointing=True,
+    )
+    model_cfg = build_model_config(cfg)
+    mm = MeshManager(cp=cp)
+    params = jax.eval_shape(
+        lambda: llama.init_params(jax.random.PRNGKey(0), model_cfg))
+    tx = optax.sgd(1.0)
+    step_fn, _, _ = make_spmd_train_step(
+        mm, llama.forward, model_cfg, tx, params,
+        attention_backend=backend, gradient_checkpointing=True,
+        max_grad_norm=1.0, donate=False,
+    )
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((1, 1, seq), jnp.int32),
+        "target_ids": jax.ShapeDtypeStruct((1, 1, seq), jnp.int32),
+        "position_ids": jax.ShapeDtypeStruct((1, seq), jnp.int32),
+    }
+    oshape = jax.eval_shape(tx.init, params)
+    hlo = step_fn.lower(params, oshape, batch).compile().as_text()
+    rep = collective_wire_bytes(hlo)
+    # The CP exchange is what differs between backends; the gradient/loss
+    # all-reduces are identical overhead on both sides and would dilute
+    # the comparison (a 2.7x attention-exchange gap reads as 1.8x total).
+    exchange = sum(b for (op, _), b in rep["by_op"].items()
+                   if op != "all-reduce")
+    return {
+        "backend": backend,
+        "wire_mb": round(rep["total"] / 1e6, 3),
+        "cp_exchange_mb": round(exchange / 1e6, 3),
+        "by_op": {f"{op}:{dt}": round(b / 1e6, 3)
+                  for (op, dt), b in rep["by_op"].items()},
+    }
+
+
+def _resolve(cp, hq, hkv, seq, hops):
+    from scaletorch_tpu.parallel.cp_select import resolve_cp_backend
+
+    return resolve_cp_backend(
+        "auto", None, cp=cp, num_q_heads=hq, num_kv_heads=hkv,
+        seq_len=seq, cross_host_hops=hops,
+    )
+
+
+def run_sweep(args) -> None:
+    env = dict(os.environ)
+    rows = []
+    for label, cp, hq, hkv, seq in TOPOLOGIES:
+        seq = args.seq if args.seq and "seq" not in label else seq
+        point = {"label": label, "cp": cp, "hq": hq, "hkv": hkv, "seq": seq}
+        for backend in ("ring", "ulysses"):
+            env[_CHILD_ENV] = f"{cp}:{hq}:{hkv}:{seq}:{backend}"
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=2400,
+                cwd=REPO,
+            )
+            lines = [ln for ln in proc.stdout.splitlines()
+                     if ln.startswith("{")]
+            if proc.returncode != 0 or not lines:
+                point[backend] = {"error": proc.stderr.strip()[-300:]}
+            else:
+                point[backend] = json.loads(lines[-1])
+            print(json.dumps({label: point[backend]}), flush=True)
+        ok = ("error" not in point.get("ring", {})
+              and "error" not in point.get("ulysses", {}))
+        if ok:
+            point["compiled_bytes_winner"] = (
+                "ring"
+                if point["ring"]["wire_mb"] <= point["ulysses"]["wire_mb"]
+                else "ulysses")
+            point["ulysses_byte_advantage"] = round(
+                point["ring"]["wire_mb"]
+                / max(point["ulysses"]["wire_mb"], 1e-9), 2)
+            # the number the resolver's 2x margin is judged against:
+            # ring-vs-ulysses on the CP exchange alone (see _compile_point)
+            point["ulysses_exchange_advantage"] = round(
+                point["ring"]["cp_exchange_mb"]
+                / max(point["ulysses"]["cp_exchange_mb"], 1e-9), 2)
+        choice = _resolve(cp, hq, hkv, seq, hops=0)
+        point["resolved"] = choice.backend
+        point["resolved_reason"] = choice.reason
+        rows.append(point)
+
+    out = {
+        "note": ("compiled collective wire bytes (ring cost model over "
+                 "HLO replica groups) per CP backend per topology; "
+                 "'resolved' is cp_select.resolve_cp_backend's verdict "
+                 "at 0 DCN hops. The resolver leaves the ICI ring only "
+                 "at a >= 2x byte margin (hops overlap with compute); "
+                 "cross-host is decided by the DCN hop count, exercised "
+                 "in --check via DOCS_TABLE_SCENARIOS."),
+        "rows": rows,
+        "docs_table": [
+            dict(s, resolved=_resolve(
+                s["cp"], s["hq"], s["hkv"], s["seq"], s["hops"]).backend)
+            for s in DOCS_TABLE_SCENARIOS
+        ],
+    }
+    path = os.path.join(REPO, args.out)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({"written": args.out, "rows": len(rows)}))
+
+
+def run_check(args) -> int:
+    """CI smoke: no compiles — the checked-in JSON must reproduce under
+    today's resolver, and the docs-table scenarios must resolve to their
+    documented answers."""
+    path = os.path.join(REPO, args.out)
+    failures = []
+    with open(path) as f:
+        data = json.load(f)
+    from scaletorch_tpu.parallel.cp_select import ICI_ULYSSES_BYTE_MARGIN
+
+    for row in data.get("rows", []):
+        choice = _resolve(row["cp"], row["hq"], row["hkv"], row["seq"],
+                          hops=0)
+        if choice.backend != row["resolved"]:
+            failures.append(
+                f"{row['label']}: resolver now says {choice.backend}, "
+                f"JSON recorded {row['resolved']} — regenerate the JSON "
+                "or fix the resolver")
+        adv = row.get("ulysses_exchange_advantage")
+        # An ulysses verdict must be backed by a compiled CP-exchange
+        # advantage clearing the SAME margin the resolver demands of the
+        # analytic model — anything weaker means the rule and evidence
+        # disagree. (Ring verdicts may have adv >= margin: the extreme-
+        # seq row is decided by memory, not bytes.)
+        if (adv is not None and choice.backend == "ulysses"
+                and adv < ICI_ULYSSES_BYTE_MARGIN
+                and "byte" in choice.reason):
+            failures.append(
+                f"{row['label']}: resolver picks ulysses on the byte "
+                f"rule but the compiled CP-exchange advantage is only "
+                f"{adv}x < {ICI_ULYSSES_BYTE_MARGIN}x")
+    for s in DOCS_TABLE_SCENARIOS:
+        got = _resolve(s["cp"], s["hq"], s["hkv"], s["seq"], s["hops"])
+        if got.backend != s["expect"]:
+            failures.append(
+                f"docs-table scenario {s['label']}: expected "
+                f"{s['expect']}, resolver says {got.backend} "
+                f"({got.reason})")
+    if failures:
+        for f_ in failures:
+            print(f"CHECK FAIL: {f_}", file=sys.stderr)
+        return 1
+    print(json.dumps({
+        "check": "ok",
+        "rows": len(data.get("rows", [])),
+        "docs_table_scenarios": len(DOCS_TABLE_SCENARIOS),
+    }))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="AOT_CP_CROSSOVER.json")
+    ap.add_argument("--seq", type=int, default=0,
+                    help="override the non-extreme topologies' seq")
+    ap.add_argument("--check", action="store_true",
+                    help="validate the checked-in JSON against the "
+                         "resolver (no compiles; CI smoke)")
+    args = ap.parse_args()
+
+    if os.environ.get(_CHILD_ENV):
+        cp, hq, hkv, seq, backend = os.environ[_CHILD_ENV].split(":")
+        print(json.dumps(_compile_point(
+            int(cp), int(hq), int(hkv), int(seq), backend)))
+        return 0
+    if args.check:
+        return run_check(args)
+    run_sweep(args)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
