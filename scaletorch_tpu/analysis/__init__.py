@@ -1,6 +1,6 @@
 """jaxlint — JAX-aware static analysis for scaletorch-tpu.
 
-Run as ``python -m scaletorch_tpu.analysis [paths]``. Five passes over
+Run as ``python -m scaletorch_tpu.analysis [paths]``. Six passes over
 plain ASTs (nothing under analysis is imported):
 
 =====  ======================================================
@@ -9,7 +9,12 @@ ST2xx  trace-safety (Python control flow / host syncs in jit)
 ST3xx  PRNG hygiene (key reuse, wall-clock seeds)
 ST4xx  donation safety (read-after-donate)
 ST5xx  retrace risk (literal args to jitted callables)
+ST6xx  SPMD collective symmetry (host-divergent deadlocks)
 =====  ======================================================
+
+``--tier deep`` adds the compiled tier (needs jax): the jaxpr/HLO
+entry-point audit (ST7xx — ``jaxpr_audit.py``) and the per-entry comm
+budget gate (ST8xx — ``budget.py`` against ``tools/comm_budget.json``).
 
 Findings print as ``file:line: CODE severity message``; a checked-in
 baseline (``tools/jaxlint_baseline.json``) suppresses pre-existing
@@ -20,7 +25,7 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Set
 
-from . import donation, prng, retrace, sharding, trace_safety
+from . import donation, prng, retrace, sharding, symmetry, trace_safety
 from .core import (
     Finding,
     SourceModule,
@@ -37,6 +42,7 @@ PASSES = {
     "prng": prng.run,
     "donation": donation.run,
     "retrace": retrace.run,
+    "symmetry": symmetry.run,
 }
 
 __all__ = [

@@ -1,20 +1,57 @@
 """CLI: ``python -m scaletorch_tpu.analysis [paths] [options]``.
 
-Exit codes: 0 clean (or all findings baselined), 1 new findings or
-syntax errors, 2 usage error. ``--write-baseline`` records the current
-findings as the allowlist; the gate then only fails on regressions.
+Two tiers:
+
+* ``--tier ast`` (default) — the pure-AST passes (ST1xx-ST6xx). Never
+  imports the code under analysis and needs no jax: this is the fast,
+  dependency-free CI ``lint`` job.
+* ``--tier deep`` — additionally traces and compiles the registered
+  entry-point manifest on virtual CPU meshes (jaxpr/HLO audit, ST7xx)
+  and checks the per-entry comm budget (``tools/comm_budget.json``,
+  ST8xx). Needs jax; run under ``JAX_PLATFORMS=cpu`` (the CLI arranges
+  8 virtual devices itself when jax is not yet initialized).
+
+Exit codes: 0 clean (or all findings baselined), 1 findings or syntax
+errors, 2 usage error (unknown pass/entry, typo'd path, unreadable or
+malformed baseline/budget file). ``--write-baseline`` records current
+AST findings as the allowlist; ``--write-budget`` records the current
+compiled comm reports as the budget.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 from pathlib import Path
 
 from . import PASSES, analyze_paths, load_baseline, save_baseline, split_by_baseline
 
 DEFAULT_BASELINE = Path("tools") / "jaxlint_baseline.json"
+
+
+def _render_github(f) -> str:
+    level = "error" if f.severity == "error" else "warning"
+    # workflow-command escaping for the message payload
+    msg = (f.message.replace("%", "%25").replace("\r", "%0D")
+           .replace("\n", "%0A"))
+    return (f"::{level} file={f.file},line={f.line},"
+            f"title=jaxlint {f.code}::{msg}")
+
+
+def _ensure_deep_env() -> None:
+    """Arrange >= 8 virtual CPU devices for the deep tier. Env vars are
+    read at first backend initialization, so this only helps when jax
+    has not been initialized yet (the normal CLI case); under an
+    already-initialized runtime (pytest) the audit checks the visible
+    device count itself and reports ST700 if it is short."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
 
 
 def main(argv=None) -> int:
@@ -27,6 +64,11 @@ def main(argv=None) -> int:
         help="files/directories to analyze (default: scaletorch_tpu)",
     )
     parser.add_argument(
+        "--tier", choices=("ast", "deep"), default="ast",
+        help="'ast' = pure-AST passes only (no jax); 'deep' also runs "
+             "the jaxpr/HLO entry-point audit and the comm-budget gate",
+    )
+    parser.add_argument(
         "--baseline", type=Path, default=None,
         help=f"baseline allowlist (default: {DEFAULT_BASELINE} if present)",
     )
@@ -36,7 +78,7 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--write-baseline", action="store_true",
-        help="write current findings to the baseline file and exit 0",
+        help="write current AST findings to the baseline file and exit 0",
     )
     parser.add_argument(
         "--select", default=None, metavar="PASS[,PASS...]",
@@ -47,9 +89,39 @@ def main(argv=None) -> int:
         help="additional mesh-axis names to treat as declared",
     )
     parser.add_argument(
-        "--format", choices=("text", "json"), default="text",
+        "--entries", default=None, metavar="NAME[,NAME...]",
+        help="deep tier: audit only these manifest entries",
+    )
+    parser.add_argument(
+        "--budget", type=Path, default=None,
+        help="comm budget file (default: tools/comm_budget.json)",
+    )
+    parser.add_argument(
+        "--write-budget", action="store_true",
+        help="deep tier: write the current compiled comm reports as the "
+             "budget and skip the comparison",
+    )
+    parser.add_argument(
+        "--no-budget", action="store_true",
+        help="deep tier: skip the comm-budget comparison",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json", "github"), default="text",
+        help="'github' emits GitHub Actions ::error/::warning "
+             "annotations so findings render inline on PRs",
     )
     args = parser.parse_args(argv)
+
+    if args.tier != "deep" and (
+        args.entries or args.write_budget or args.budget
+        or args.no_budget
+    ):
+        print(
+            "error: --entries/--write-budget/--budget/--no-budget need "
+            "--tier deep",
+            file=sys.stderr,
+        )
+        return 2
 
     select = [s.strip() for s in args.select.split(",") if s.strip()] \
         if args.select else None
@@ -74,27 +146,88 @@ def main(argv=None) -> int:
 
     suppressed_count = 0
     if baseline_path is not None and not args.no_baseline:
-        findings, suppressed = split_by_baseline(
-            findings, load_baseline(baseline_path)
-        )
+        # An unreadable or malformed baseline must not traceback AND must
+        # not silently ungate: it is a usage error, like a typo'd path.
+        try:
+            entries = load_baseline(baseline_path)
+        except (OSError, json.JSONDecodeError, ValueError) as e:
+            print(
+                f"error: baseline {baseline_path} is unreadable or "
+                f"malformed ({e}); fix it or rerun with --no-baseline / "
+                "--write-baseline",
+                file=sys.stderr,
+            )
+            return 2
+        findings, suppressed = split_by_baseline(findings, entries)
         suppressed_count = len(suppressed)
 
-    findings = list(errors) + findings
+    deep_findings = []
+    if args.tier == "deep":
+        _ensure_deep_env()
+        from . import budget as budget_mod
+        from .jaxpr_audit import audit_all
+
+        entry_names = [s.strip() for s in args.entries.split(",")
+                       if s.strip()] if args.entries else None
+        audit_findings, reports = audit_all(entry_names)
+        deep_findings.extend(audit_findings)
+        budget_path = args.budget or budget_mod.DEFAULT_BUDGET
+        if args.write_budget:
+            if entry_names and budget_path.is_file():
+                # A scoped re-baseline must not truncate the other
+                # entries' budgets: merge into the existing file.
+                try:
+                    existing = budget_mod.load_budget(budget_path)
+                except ValueError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+                reports = {**existing["entries"], **reports}
+            budget_mod.write_budget(budget_path, reports)
+            # status to stderr: --format json contracts stdout to be
+            # exactly the findings array
+            print(f"wrote comm budget for {len(reports)} entr"
+                  f"{'y' if len(reports) == 1 else 'ies'} to {budget_path}",
+                  file=sys.stderr)
+        elif not args.no_budget:
+            budget_findings, usage_error = budget_mod.check_budget_path(
+                reports, budget_path
+            )
+            if usage_error is not None:
+                print(f"error: {usage_error}", file=sys.stderr)
+                return 2
+            deep_findings.extend(budget_findings)
+
+    # Gate semantics: AST findings and syntax errors fail regardless of
+    # severity (the historical contract — retrace warnings etc. are
+    # actionable at the source line). Deep-tier WARNINGS do not gate:
+    # they exist precisely for the jax-version-drift downgrade in
+    # budget.py, where a red job no author can fix would be wrong — the
+    # rendered ::warning annotation is the signal.
+    gating = (
+        list(errors) + findings
+        + [f for f in deep_findings if f.severity == "error"]
+    )
+    findings = list(errors) + findings + deep_findings
     if args.format == "json":
         print(json.dumps(
             [f.__dict__ for f in findings], indent=2
         ))
+    elif args.format == "github":
+        for f in findings:
+            print(_render_github(f))
     else:
         for f in findings:
             print(f.render())
+    if args.format != "json":
         n_err = sum(1 for f in findings if f.severity == "error")
         n_warn = len(findings) - n_err
         tail = f" ({suppressed_count} baselined)" if suppressed_count else ""
+        tier = " [deep]" if args.tier == "deep" else ""
         print(
-            f"jaxlint: {n_err} error(s), {n_warn} warning(s){tail}",
+            f"jaxlint{tier}: {n_err} error(s), {n_warn} warning(s){tail}",
             file=sys.stderr,
         )
-    return 1 if findings else 0
+    return 1 if gating else 0
 
 
 if __name__ == "__main__":

@@ -116,10 +116,23 @@ def collect_files(
 # ---- baseline ---------------------------------------------------------------
 
 def load_baseline(path: Path) -> List[dict]:
+    """Baseline entries; raises OSError/JSONDecodeError/ValueError on an
+    unreadable or malformed file — the CLI maps those to a usage error
+    (exit 2) so a mangled baseline can neither traceback nor silently
+    turn the gate green."""
     data = json.loads(path.read_text(encoding="utf-8"))
     if isinstance(data, dict):
-        return list(data.get("findings", []))
-    return list(data)
+        entries = data.get("findings", [])
+    else:
+        entries = data
+    if not isinstance(entries, list) or not all(
+        isinstance(e, dict) for e in entries
+    ):
+        raise ValueError(
+            "baseline must be a list of {file, code, message} objects "
+            "(or {\"findings\": [...]})"
+        )
+    return list(entries)
 
 
 def save_baseline(path: Path, findings: Iterable[Finding]) -> None:

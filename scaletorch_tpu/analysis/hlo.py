@@ -1,0 +1,154 @@
+"""Compiled-HLO collective parser — shared analysis infrastructure.
+
+Historically this lived in ``ops/quantized_collectives.py`` (it was born
+as the attestation backend for the int8 all-reduce's "4x fewer wire
+bytes" claim), but it is analysis code, not numerics: the byte
+attestation test, ``tools/aot_cp_crossover.py``, the deep-tier jaxpr/HLO
+audit (``analysis/jaxpr_audit.py``) and the comm-budget gate
+(``analysis/budget.py``) all read compiled HLO through it. The old
+import path re-exports for back-compat.
+
+Pure stdlib (``re`` over HLO text) — importing this module never pulls
+in jax, so the pure-AST lint tier stays jax-free.
+
+Two levels of API:
+
+* ``parse_collectives(hlo_text)`` — one ``HloCollective`` record per
+  collective instruction (op, payload dtype, result bytes, replica-group
+  size, ring-model wire bytes, line number).
+* ``collective_wire_bytes(hlo_text)`` — the historical aggregate:
+  ``{"by_op": {(op, dtype): bytes}, "total": bytes}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List
+
+# result side may be one array or a tuple: `= f32[4,8]{1,0} all-reduce(`
+# or `= (f32[4]{0}, /*index=5*/f32[4]{0}, ...) all-to-all(` — long tuples
+# carry /*index=N*/ comments, so '=' may appear inside the result part.
+_HLO_COLLECTIVE_RE = re.compile(
+    r"= *(\(?[a-z0-9]+\[.*?) "
+    r"(all-reduce|all-gather|all-to-all|reduce-scatter|"
+    r"collective-permute)(?:-start)?\("
+)
+_HLO_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
+_HLO_GROUP_RE = re.compile(
+    r"replica_groups=(\{\{[^}]*\}[^}]*\}|\[[^\]]*\]<=\[[^\]]*\])"
+)
+_HLO_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "u32": 4, "s32": 4, "bf16": 2,
+                "f16": 2, "s8": 1, "u8": 1, "pred": 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class HloCollective:
+    """One collective instruction from a compiled HLO module."""
+
+    op: str             # all-reduce | all-gather | all-to-all | ...
+    dtype: str          # first payload dtype in the result shape (f32, s8…)
+    result_bytes: int   # total bytes of the result shape(s)
+    group_size: int     # participants per replica group (1 = trivial)
+    wire_bytes: float   # ring/bidirectional-exchange cost-model estimate
+    line_no: int        # 1-based line in the HLO text (diagnostics)
+
+
+def _replica_group_size(group_match) -> int:
+    """Participants per replica group, from either HLO syntax:
+    ``{{0,2},{1,3}}`` (explicit) or ``[4,2]<=[8]`` (iota: groups x size)."""
+    if group_match is None:
+        return 1
+    text = group_match.group(1)
+    if text.startswith("{"):
+        first = text[1:].split("}", 1)[0].lstrip("{")
+        return len([t for t in first.split(",") if t.strip()])
+    dims = text.split("<=", 1)[0].strip("[]").split(",")
+    return int(dims[1]) if len(dims) > 1 else 1
+
+
+def parse_collectives(hlo_text: str) -> List[HloCollective]:
+    """Every non-trivial collective instruction in a compiled HLO module.
+
+    Cost model (ring/bidirectional-exchange, from the RESULT shape and
+    replica-group size g):
+
+        all-reduce:          2 * bytes * (g-1)/g
+        all-gather/all-to-all:   bytes * (g-1)/g
+        reduce-scatter:          bytes * (g-1)        (result is 1/g)
+        collective-permute:      bytes                (one hop)
+
+    Trivial groups (g == 1 — e.g. a pmean over a size-1 mesh axis, which
+    XLA still emits as an all-reduce instruction) move nothing and are
+    excluded.
+    """
+    out: List[HloCollective] = []
+    for line_no, line in enumerate(hlo_text.splitlines(), start=1):
+        m = _HLO_COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        result_part, op = m.groups()
+        nbytes = 0
+        dt = None
+        for dt_i, shape in _HLO_SHAPE_RE.findall(result_part):
+            elems = 1
+            for d in shape.split(","):
+                if d.strip():
+                    elems *= int(d)
+            nbytes += elems * _DTYPE_BYTES.get(dt_i, 4)
+            dt = dt or dt_i
+        if not nbytes:
+            continue
+        # Async '-start' forms return (operand-alias, output[, ...]) —
+        # summing the tuple double-counts the payload relative to the
+        # sync form's result-shape convention. Halving restores parity
+        # (exact for the symmetric permute/all-reduce pairs, and for
+        # all-gather-start's in+out = out·(1+1/g) it slightly
+        # UNDER-counts — never inflates a backend's bytes).
+        if f"{op}-start(" in line and result_part.lstrip().startswith("("):
+            nbytes //= 2
+        if op == "collective-permute":
+            # a permute carries source_target_pairs, not replica_groups;
+            # each participating device ships its full shard one hop
+            pairs = _HLO_PAIRS_RE.search(line)
+            if pairs is None or not pairs.group(1).strip("{}").strip():
+                continue
+            group = 2
+            wire = float(nbytes)
+        else:
+            group = _replica_group_size(_HLO_GROUP_RE.search(line))
+            if group <= 1:
+                continue
+            wire = {
+                "all-reduce": 2.0 * nbytes * (group - 1) / group,
+                "all-gather": nbytes * (group - 1) / group,
+                "all-to-all": nbytes * (group - 1) / group,
+                "reduce-scatter": float(nbytes) * (group - 1),
+            }[op]
+        out.append(HloCollective(
+            op=op, dtype=dt or "f32", result_bytes=nbytes,
+            group_size=group, wire_bytes=wire, line_no=line_no,
+        ))
+    return out
+
+
+def collective_wire_bytes(hlo_text: str) -> dict:
+    """Per-(op, dtype) wire-byte totals for the collectives in a compiled
+    HLO module — the attestation backend for "the int8 path really moves
+    ~4x fewer bytes" (tests/ops/test_quantized_collectives.py), for the
+    ring-vs-ulysses CP comparison (tools/aot_cp_crossover.py), and for
+    the per-entry-point comm budget (analysis/budget.py).
+
+    Returns ``{"by_op": {(op, dtype): bytes}, "total": bytes}`` (see
+    ``parse_collectives`` for the cost model and exclusions).
+    """
+    by_op: dict = {}
+    total = 0.0
+    for rec in parse_collectives(hlo_text):
+        by_op[(rec.op, rec.dtype)] = (
+            by_op.get((rec.op, rec.dtype), 0.0) + rec.wire_bytes
+        )
+        total += rec.wire_bytes
+    return {"by_op": by_op, "total": total}

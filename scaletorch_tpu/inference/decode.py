@@ -142,6 +142,85 @@ def make_decode_step(
     )
 
 
+def _audit_cfg_and_cache():
+    """Shared tiny setup for the two inference audit targets below."""
+    from scaletorch_tpu.inference.kv_cache import init_kv_cache
+    from scaletorch_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    b, s_max = 2, 32
+    params = jax.eval_shape(
+        lambda: init_params(jax.random.PRNGKey(0), cfg))
+    cache = jax.eval_shape(
+        lambda: init_kv_cache(cfg, b, s_max, dtype=jnp.float32))
+    base_keys = jax.ShapeDtypeStruct((b, 2), jnp.uint32)
+    return cfg, params, cache, base_keys, b, s_max
+
+
+def audit_entry_prefill():
+    """Deep-tier audit target (analysis/jaxpr_audit.py): the jitted
+    prefill step on one device. Contract: cache donation survives
+    lowering (``donate_cache=True`` — the CPU default skips donation,
+    which is exactly what the audit must not silently accept), and the
+    single-device step compiles to ZERO collectives — any collective
+    that appears is unbudgeted by definition (tools/comm_budget.json
+    records an empty set for this entry)."""
+    cfg, params, cache, base_keys, b, s_max = _audit_cfg_and_cache()
+    fn = make_prefill_step(
+        cfg, SamplingParams(temperature=0.0), donate_cache=True)
+    args = (
+        params,
+        jax.ShapeDtypeStruct((b, s_max), jnp.int32),   # tokens
+        jax.ShapeDtypeStruct((b,), jnp.int32),         # lengths
+        jax.ShapeDtypeStruct((b,), jnp.bool_),         # write_mask
+        cache,
+        base_keys,
+    )
+    return {
+        "name": "prefill_step",
+        "file": "scaletorch_tpu/inference/decode.py",
+        "fn": fn,
+        "args": args,
+        "min_devices": 1,
+        "quantized_axis": None,
+        "expect_donation": True,
+        "hoisted_axes": (),
+        "max_collective_result_mb": 1.0,
+    }
+
+
+def audit_entry_decode():
+    """Deep-tier audit target: the jitted one-token decode step on one
+    device (same contract as ``audit_entry_prefill``)."""
+    cfg, params, cache, base_keys, b, _ = _audit_cfg_and_cache()
+    fn = make_decode_step(
+        cfg, SamplingParams(temperature=0.0), donate_cache=True)
+    args = (
+        params,
+        jax.ShapeDtypeStruct((b,), jnp.int32),         # tokens
+        jax.ShapeDtypeStruct((b,), jnp.int32),         # positions
+        jax.ShapeDtypeStruct((b,), jnp.bool_),         # active
+        cache,
+        base_keys,
+    )
+    return {
+        "name": "decode_step",
+        "file": "scaletorch_tpu/inference/decode.py",
+        "fn": fn,
+        "args": args,
+        "min_devices": 1,
+        "quantized_axis": None,
+        "expect_donation": True,
+        "hoisted_axes": (),
+        "max_collective_result_mb": 1.0,
+    }
+
+
 def teacher_forced_decode(
     params,
     cfg,

@@ -38,7 +38,6 @@ bandwidth-bound axis (default ``dp``) through here.
 
 from __future__ import annotations
 
-import re
 from typing import Any, Tuple
 
 import jax
@@ -158,100 +157,13 @@ def reduced_pmean(x: jax.Array, axis: str, dtype: str,
     )
 
 
-# result side may be one array or a tuple: `= f32[4,8]{1,0} all-reduce(`
-# or `= (f32[4]{0}, /*index=5*/f32[4]{0}, ...) all-to-all(` — long tuples
-# carry /*index=N*/ comments, so '=' may appear inside the result part.
-_HLO_COLLECTIVE_RE = re.compile(
-    r"= *(\(?[a-z0-9]+\[.*?) "
-    r"(all-reduce|all-gather|all-to-all|reduce-scatter|"
-    r"collective-permute)(?:-start)?\("
+# The HLO collective parser grew up here as this module's attestation
+# backend but is analysis infrastructure shared by the byte-attestation
+# test, tools/aot_cp_crossover.py and the deep-tier comm-budget gate;
+# it now lives in analysis/hlo.py and is re-exported for back-compat.
+from scaletorch_tpu.analysis.hlo import (  # noqa: E402,F401
+    collective_wire_bytes,
 )
-_HLO_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([\d,]*)\]")
-_HLO_GROUP_RE = re.compile(
-    r"replica_groups=(\{\{[^}]*\}[^}]*\}|\[[^\]]*\]<=\[[^\]]*\])"
-)
-_HLO_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^=]*?\})\}")
-
-
-def collective_wire_bytes(hlo_text: str) -> dict:
-    """Per-(op, dtype) wire-byte totals for the collectives in a compiled
-    HLO module — the attestation backend for "the int8 path really moves
-    ~4x fewer bytes" (tests/ops/test_quantized_collectives.py) and for the
-    ring-vs-ulysses CP comparison (tools/aot_cp_crossover.py).
-
-    Cost model: ring/bidirectional-exchange estimates from the RESULT
-    shape and replica-group size g —
-
-        all-reduce:          2 * bytes * (g-1)/g
-        all-gather/all-to-all:   bytes * (g-1)/g
-        reduce-scatter:          bytes * (g-1)        (result is 1/g)
-        collective-permute:      bytes                (one hop)
-
-    Trivial groups (g == 1 — e.g. a pmean over a size-1 mesh axis, which
-    XLA still emits as an all-reduce instruction) move nothing and are
-    excluded. Returns {"by_op": {(op, dtype): bytes}, "total": bytes}.
-    """
-    dtype_bytes = {"f64": 8, "f32": 4, "u32": 4, "s32": 4, "bf16": 2,
-                   "f16": 2, "s8": 1, "u8": 1, "pred": 1}
-    by_op: dict = {}
-    total = 0.0
-    for line in hlo_text.splitlines():
-        m = _HLO_COLLECTIVE_RE.search(line)
-        if not m:
-            continue
-        result_part, op = m.groups()
-        nbytes = 0
-        dt = None
-        for dt_i, shape in _HLO_SHAPE_RE.findall(result_part):
-            elems = 1
-            for d in shape.split(","):
-                if d.strip():
-                    elems *= int(d)
-            nbytes += elems * dtype_bytes.get(dt_i, 4)
-            dt = dt or dt_i
-        if not nbytes:
-            continue
-        # Async '-start' forms return (operand-alias, output[, ...]) —
-        # summing the tuple double-counts the payload relative to the
-        # sync form's result-shape convention. Halving restores parity
-        # (exact for the symmetric permute/all-reduce pairs, and for
-        # all-gather-start's in+out = out·(1+1/g) it slightly
-        # UNDER-counts — never inflates a backend's bytes).
-        if f"{op}-start(" in line and result_part.lstrip().startswith("("):
-            nbytes //= 2
-        if op == "collective-permute":
-            # a permute carries source_target_pairs, not replica_groups;
-            # each participating device ships its full shard one hop
-            pairs = _HLO_PAIRS_RE.search(line)
-            if pairs is None or not pairs.group(1).strip("{}").strip():
-                continue
-            wire = float(nbytes)
-        else:
-            g = _replica_group_size(_HLO_GROUP_RE.search(line))
-            if g <= 1:
-                continue
-            wire = {
-                "all-reduce": 2.0 * nbytes * (g - 1) / g,
-                "all-gather": nbytes * (g - 1) / g,
-                "all-to-all": nbytes * (g - 1) / g,
-                "reduce-scatter": float(nbytes) * (g - 1),
-            }[op]
-        by_op[(op, dt)] = by_op.get((op, dt), 0.0) + wire
-        total += wire
-    return {"by_op": by_op, "total": total}
-
-
-def _replica_group_size(group_match) -> int:
-    """Participants per replica group, from either HLO syntax:
-    ``{{0,2},{1,3}}`` (explicit) or ``[4,2]<=[8]`` (iota: groups x size)."""
-    if group_match is None:
-        return 1
-    text = group_match.group(1)
-    if text.startswith("{"):
-        first = text[1:].split("}", 1)[0].lstrip("{")
-        return len([t for t in first.split(",") if t.strip()])
-    dims = text.split("<=", 1)[0].strip("[]").split(",")
-    return int(dims[1]) if len(dims) > 1 else 1
 
 
 def quantized_pmean_tree(

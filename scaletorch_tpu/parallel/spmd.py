@@ -814,6 +814,68 @@ def make_spmd_train_step(
     )
 
 
+def audit_entry(
+    grad_allreduce_dtype: str = "int8", donate: bool = True
+) -> Dict[str, Any]:
+    """Deep-tier audit target (analysis/jaxpr_audit.py): the REAL SPMD
+    train step, built tiny on the (dp2, cp2, tp2) virtual CPU mesh with
+    the int8 gradient all-reduce configured on the dp edge.
+
+    The returned contract pins the invariants the compiled artifact must
+    keep: the dp edge carries int8 wire (``quantized_axis`` is the
+    attested contract, deliberately NOT derived from the arguments — a
+    config drift to fp32 must FAIL the audit, not relax it), donation
+    survives lowering, no dp collective hides inside the accumulation
+    scan (the no_sync/single-flush design), and no collective result
+    exceeds a few times the parameter footprint (the silently-replicated
+    -intermediate signature). ``grad_allreduce_dtype``/``donate`` exist
+    so tests can inject exactly those regressions.
+    """
+    import jax.random as jrandom
+
+    from scaletorch_tpu.models import llama
+
+    model_cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    mm = MeshManager(dp=2, cp=2, tp=2)
+    params = jax.eval_shape(
+        lambda: llama.init_params(jrandom.PRNGKey(0), model_cfg))
+    tx = optax.sgd(0.1)
+    step_fn, _, _ = make_spmd_train_step(
+        mm, llama.forward, model_cfg, tx, params,
+        max_grad_norm=1.0, donate=donate,
+        grad_allreduce_dtype=grad_allreduce_dtype, grad_allreduce_axis="dp",
+    )
+    seq = 128
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((2, 2, seq), jnp.int32),
+        "target_ids": jax.ShapeDtypeStruct((2, 2, seq), jnp.int32),
+        "position_ids": jax.ShapeDtypeStruct((2, seq), jnp.int32),
+    }
+    oshape = jax.eval_shape(tx.init, params)
+    param_mb = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(params)
+    ) / 1e6
+    return {
+        "name": "spmd_train_step",
+        "file": "scaletorch_tpu/parallel/spmd.py",
+        "fn": step_fn,
+        "args": (params, oshape, batch),
+        "min_devices": 8,
+        "quantized_axis": ("dp", "int8"),
+        # like quantized_axis, the attested contract — NOT echoed from
+        # the ``donate`` argument, so building with donate=False is the
+        # injected regression the audit must catch
+        "expect_donation": True,
+        "hoisted_axes": ("dp",),
+        "max_collective_result_mb": max(1.0, 4.0 * param_mb),
+    }
+
+
 def shard_params(mm: MeshManager, params: Any, p_specs: Any) -> Any:
     """Distribute a host param tree to its mesh shardings. Multi-process
     safe: every process holds the same host tree (same init seed / same

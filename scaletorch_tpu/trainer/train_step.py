@@ -307,6 +307,63 @@ def _make_quantized_dp_step(
     )
 
 
+def audit_entry(
+    grad_allreduce_dtype: str = "int8", donate: bool = True
+) -> Dict[str, Any]:
+    """Deep-tier audit target (analysis/jaxpr_audit.py): the declarative
+    step's quantized-DP variant on a pure dp=8 virtual CPU mesh.
+
+    Contract (see parallel/spmd.audit_entry for the semantics of each
+    field): the single per-step gradient synchronisation carries int8 on
+    the dp axis (``quantized_axis`` is the attested contract, not echoed
+    from the arguments), params/opt-state donation survives lowering,
+    and the per-shard accumulation scan stays collective-free over dp.
+    """
+    import jax.random as jrandom
+    from jax.sharding import PartitionSpec as P
+
+    from scaletorch_tpu.models import llama
+    from scaletorch_tpu.parallel.mesh import MeshManager
+
+    model_cfg = llama.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=256,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+    mm = MeshManager(dp=8)
+    tx = optax.sgd(0.1)
+    step_fn = make_train_step(
+        llama.forward, model_cfg, tx,
+        mesh=mm.mesh, data_spec=P(None, "dp", None),
+        donate=donate, grad_allreduce_dtype=grad_allreduce_dtype,
+    )
+    params = jax.eval_shape(
+        lambda: llama.init_params(jrandom.PRNGKey(0), model_cfg))
+    oshape = jax.eval_shape(tx.init, params)
+    seq = 64
+    batch = {
+        "input_ids": jax.ShapeDtypeStruct((2, 8, seq), jnp.int32),
+        "target_ids": jax.ShapeDtypeStruct((2, 8, seq), jnp.int32),
+    }
+    param_mb = sum(
+        l.size * l.dtype.itemsize for l in jax.tree_util.tree_leaves(params)
+    ) / 1e6
+    return {
+        "name": "declarative_train_step",
+        "file": "scaletorch_tpu/trainer/train_step.py",
+        "fn": step_fn,
+        "args": (params, oshape, batch),
+        "min_devices": 8,
+        "quantized_axis": ("dp", "int8"),
+        # pinned contract, not echoed from ``donate`` (see
+        # parallel/spmd.audit_entry)
+        "expect_donation": True,
+        "hoisted_axes": ("dp",),
+        "max_collective_result_mb": max(1.0, 4.0 * param_mb),
+    }
+
+
 def make_eval_step(forward: Callable, cfg, *, attention_backend: str = "sdpa"):
     loss_fn = make_loss_fn(
         forward, cfg, attention_backend=attention_backend,

@@ -1,0 +1,46 @@
+"""Seeded ST6xx bugs: host-divergent collectives, shaped like the real
+CoordinatedResilience / DecisionBus / CheckpointManager call patterns.
+Parsed by tests, never imported."""
+import os
+import time
+
+import jax
+from jax.experimental import multihost_utils
+
+
+class BrokenCoordinator:
+    """after_step with the gather moved INSIDE the host-0 branch — the
+    exact one-sided-decision bug CoordinatedResilience exists to
+    prevent."""
+
+    def __init__(self, bus, manager):
+        self.bus = bus
+        self.manager = manager
+
+    def after_step(self, step, metrics):
+        local = {"loss": float(metrics["loss"]), "stop": False}
+        decision = None
+        if jax.process_index() == 0:
+            observations = self.bus.all_gather(local)      # ST601
+            decision = max(o["loss"] for o in observations)
+        return decision
+
+    def stop_poll(self):
+        if self.bus.is_main:
+            return self.manager.stop_requested
+        return self.bus.agree_any(self.manager.stop_requested)  # ST601
+
+    def drain(self, ckpt_mgr):
+        # fs-guarded orbax drain: the marker exists on one host only
+        if os.path.exists("/tmp/ckpt_marker"):
+            ckpt_mgr.wait_until_finished()                 # ST603
+
+    def save_with_local_retry(self, ckpt_mgr, step, state):
+        try:
+            ckpt_mgr.save(step, state)
+        except OSError:
+            ckpt_mgr.save(step, state)                     # ST602
+
+    def timed_barrier(self, deadline):
+        while time.monotonic() < deadline:
+            multihost_utils.sync_global_devices("tick")    # ST603

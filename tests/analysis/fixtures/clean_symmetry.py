@@ -1,0 +1,70 @@
+"""The symmetric-protocol idioms the ST6xx pass must NEVER flag — the
+agreed-broadcast shapes CoordinatedResilience / CheckpointManager /
+dist.py actually use. Parsed by tests, never imported."""
+import jax
+
+from scaletorch_tpu.dist import all_gather_object
+
+
+class GoodCoordinator:
+    """Host 0 FORMS the decision under a rank guard (local compute, no
+    collective), every host ENTERS the gather/broadcast unconditionally,
+    and result visibility is rank-gated only after the collective."""
+
+    def __init__(self, bus, manager):
+        self.bus = bus
+        self.manager = manager
+
+    def after_step(self, step, metrics):
+        local = {"loss": float(metrics["loss"]), "stop": False}
+        observations = self.bus.all_gather(local)
+        decision = None
+        if self.bus.is_main:
+            decision = max(o["loss"] for o in observations)
+        decision = self.bus.broadcast_from_main(decision)
+        return decision
+
+    def broadcast_payload(self, obj):
+        # IfExp payload selection is not a guard: every host calls
+        return self.bus.broadcast([obj if self.bus.is_main else None])
+
+    def gather_to_main(self, obj):
+        out = all_gather_object(obj)
+        if jax.process_index() != 0:
+            return None
+        return out
+
+    def singleprocess_shortcut(self, obj):
+        # process_count is UNIFORM across hosts — branching on it is
+        # symmetric by construction (dist.py barrier/all_gather_object)
+        if jax.process_count() == 1:
+            return [obj]
+        return all_gather_object(obj)
+
+    def coordinated_retry(self, ckpt_mgr, step, state):
+        # the utils/checkpoint.py pattern: attempt under try, gather the
+        # OUTCOMES (collective outside the handler), decide in lockstep
+        for _ in range(3):
+            err = None
+            try:
+                ckpt_mgr.save(step, state)
+            except OSError as exc:
+                err = exc
+            statuses = self.bus.all_gather(err is None)
+            if all(statuses):
+                return True
+        return False
+
+    def retire_stale_step(self, ckpt_mgr, step):
+        # host-local directory action under a rank guard is fine — only
+        # COLLECTIVES must be symmetric, and delete() is not one
+        if self.bus.is_main:
+            ckpt_mgr.delete(step)
+
+    def deferred_callback(self, obj):
+        # DEFINING a callback under a rank guard is not ENTERING a
+        # collective there — nested lambda/def bodies are pruned
+        if self.bus.is_main:
+            cb = lambda: self.bus.all_gather(obj)  # noqa: E731
+            return cb
+        return None

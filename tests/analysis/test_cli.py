@@ -77,6 +77,73 @@ class TestOutputFormat:
         assert rc == 0 and out == ""
 
 
+class TestGithubFormat:
+    def test_error_and_warning_annotations(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_symmetry.py"), "--no-baseline",
+            "--format", "github",
+        )
+        assert rc == 1
+        lines = out.splitlines()
+        assert any(
+            ln.startswith("::error file=") and "title=jaxlint ST601" in ln
+            for ln in lines
+        )
+        assert any(ln.startswith("::warning file=") for ln in lines)
+        # every annotation carries a file and a line anchor
+        assert all(
+            ",line=" in ln for ln in lines if ln.startswith("::")
+        )
+
+    def test_json_format_unchanged_by_new_flags(self, capsys):
+        """--format json stays byte-compatible: same keys, same shape."""
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_retrace.py"), "--no-baseline",
+            "--format", "json",
+        )
+        data = json.loads(out)
+        assert rc == 1 and data
+        assert set(data[0]) == {"file", "line", "code", "severity",
+                                "message"}
+
+
+class TestMalformedBaseline:
+    def test_invalid_json_is_usage_error(self, capsys, tmp_path):
+        bad = tmp_path / "baseline.json"
+        bad.write_text("{not json", encoding="utf-8")
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--baseline", str(bad)
+        )
+        assert rc == 2
+        assert "malformed" in err and "Traceback" not in err
+
+    def test_wrong_shape_is_usage_error(self, capsys, tmp_path):
+        bad = tmp_path / "baseline.json"
+        bad.write_text('{"findings": "oops"}', encoding="utf-8")
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--baseline", str(bad)
+        )
+        assert rc == 2
+        assert "malformed" in err
+
+    def test_missing_explicit_baseline_is_usage_error(self, capsys, tmp_path):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"),
+            "--baseline", str(tmp_path / "nope.json"),
+        )
+        assert rc == 2
+        assert "unreadable" in err
+
+    def test_deep_flags_need_deep_tier(self, capsys):
+        for flag in (["--write-budget"], ["--no-budget"],
+                     ["--budget", "x.json"], ["--entries", "decode_step"]):
+            rc, _, err = run_cli(
+                capsys, str(FIXTURES / "clean.py"), *flag
+            )
+            assert rc == 2, flag
+            assert "--tier deep" in err
+
+
 class TestBaseline:
     def test_write_then_gate_passes(self, capsys, tmp_path):
         baseline = tmp_path / "baseline.json"

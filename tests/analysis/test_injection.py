@@ -73,6 +73,50 @@ class TestInjectedAxisTypo:
         ), [f.render() for f in findings]
 
 
+class TestInjectedDivergentGather:
+    """The ST6xx pass catches a host-divergence bug injected into the
+    REAL resilience module: a DecisionBus gather call site wrapped in
+    ``if process_index() == 0:`` — the one-sided decision that wedges
+    the fleet (the static dual of the HangWatchdog)."""
+
+    SRC = PKG / "resilience_distributed.py"
+    NEEDLE = "        observations = self.bus.all_gather(local)"
+
+    def _symmetry(self, tmp_path, src):
+        mutated = tmp_path / "resilience_distributed.py"
+        mutated.write_text(src, encoding="utf-8")
+        modules, errors = collect_files([str(mutated)])
+        assert not errors
+        return analyze(modules, select=["symmetry"])
+
+    def test_divergent_gather_detected(self, tmp_path):
+        src = self.SRC.read_text()
+        assert self.NEEDLE in src, "after_step gather moved; update test"
+        guarded = (
+            "        import jax\n"
+            "        if jax.process_index() == 0:\n"
+            "            observations = self.bus.all_gather(local)\n"
+        )
+        findings = self._symmetry(
+            tmp_path, src.replace(self.NEEDLE, guarded.rstrip("\n"))
+        )
+        assert any(
+            f.code == "ST601" and "all_gather" in f.message
+            for f in findings
+        ), [f.render() for f in findings]
+
+    def test_unmutated_resilience_modules_are_clean(self, tmp_path):
+        """The real coordinated-decision protocol lints clean: the pass
+        proves the absence of the bug class in the modules that carry
+        the fleet's collectives."""
+        for rel in ("resilience_distributed.py", "utils/checkpoint.py",
+                    "dist.py", "trainer/trainer.py"):
+            modules, errors = collect_files([str(PKG / rel)])
+            assert not errors
+            findings = analyze(modules, select=["symmetry"])
+            assert findings == [], [f.render() for f in findings]
+
+
 class TestRepoGate:
     def test_package_and_tools_lint_clean_with_baseline(self):
         """The exact CI gate: repo findings minus baseline is empty."""

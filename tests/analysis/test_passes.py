@@ -109,14 +109,43 @@ class TestRetracePass:
         assert not [f for f in findings if f.line >= 23]
 
 
+class TestSymmetryPass:
+    def test_catches_seeded_bugs(self):
+        got = codes_at(run_fixture("bad_symmetry.py", select=["symmetry"]))
+        assert ("ST601", 24) in got  # gather inside host-0 branch
+        assert ("ST601", 31) in got  # agree_any on the non-main complement
+        assert ("ST603", 36) in got  # fs-guarded orbax drain
+        assert ("ST602", 42) in got  # save retried inside except handler
+        assert ("ST603", 46) in got  # wall-clock-guarded barrier
+
+    def test_severities(self):
+        findings = run_fixture("bad_symmetry.py", select=["symmetry"])
+        by_code = {f.code: f.severity for f in findings}
+        assert by_code["ST601"] == "error"
+        assert by_code["ST602"] == "warning"
+        assert by_code["ST603"] == "warning"
+
+    def test_agreed_broadcast_protocol_not_flagged(self):
+        """The CoordinatedResilience idioms — unconditional gather with
+        rank-gated computation/visibility around it, IfExp payloads,
+        process_count branches, coordinated retry with the gather
+        outside the handler, host-local actions under rank guards —
+        must all stay quiet."""
+        findings = run_fixture("clean_symmetry.py", select=["symmetry"])
+        assert findings == [], [f.render() for f in findings]
+
+
 class TestCleanFixture:
     def test_zero_false_positives(self):
         findings = run_fixture("clean.py")
         assert findings == [], [f.render() for f in findings]
 
     @pytest.mark.parametrize(
-        "pass_name", ["sharding", "trace-safety", "prng", "donation", "retrace"]
+        "pass_name",
+        ["sharding", "trace-safety", "prng", "donation", "retrace",
+         "symmetry"],
     )
     def test_each_pass_individually_quiet(self, pass_name):
-        findings = run_fixture("clean.py", select=[pass_name])
-        assert findings == [], [f.render() for f in findings]
+        for fixture in ("clean.py", "clean_symmetry.py"):
+            findings = run_fixture(fixture, select=[pass_name])
+            assert findings == [], [f.render() for f in findings]

@@ -9,7 +9,7 @@ table's guesswork with compiled evidence, the same way
 for each (cp, head-geometry, seq) topology it compiles the REAL spmd
 train step on a virtual cp-mesh with BOTH backends and records the
 collective wire bytes XLA actually emits
-(ops/quantized_collectives.collective_wire_bytes ring-cost model), plus
+(analysis/hlo.collective_wire_bytes ring-cost model), plus
 the resolver's verdict for that topology.
 
 Two modes:
@@ -84,11 +84,9 @@ def _compile_point(cp: int, hq: int, hkv: int, seq: int,
     import optax
 
     import scaletorch_tpu  # noqa: F401 — compat backfill on old jax
+    from scaletorch_tpu.analysis.hlo import collective_wire_bytes
     from scaletorch_tpu.config import ScaleTorchTPUArguments
     from scaletorch_tpu.models import llama
-    from scaletorch_tpu.ops.quantized_collectives import (
-        collective_wire_bytes,
-    )
     from scaletorch_tpu.parallel.mesh import MeshManager
     from scaletorch_tpu.parallel.spmd import make_spmd_train_step
     from scaletorch_tpu.trainer.trainer import build_model_config
