@@ -601,6 +601,59 @@ class ResilienceArguments:
                           "retry + skip-and-log path. Env override: "
                           "SCALETORCH_TPU_FT_BAD_BATCH_STEP."},
     )
+    # Serving fault injection (inference.resilience.ServingFaultInjector;
+    # steps are 1-based DECODE steps of the engine's lifetime)
+    ft_serve_nan_at_step: int = field(
+        default=0,
+        metadata={"help": "Serving drill: NaN-poison one slot's KV cache "
+                          "before decode step k (0 = off; fires once) so "
+                          "its logits go non-finite — drives the "
+                          "quarantine path. Env override: "
+                          "SCALETORCH_TPU_FT_SERVE_NAN_STEP."},
+    )
+    ft_serve_nan_slot: int = field(
+        default=0,
+        metadata={"help": "Slot index the ft_serve_nan_at_step drill "
+                          "poisons (falls back to the first active slot). "
+                          "Env override: SCALETORCH_TPU_FT_SERVE_NAN_SLOT."},
+    )
+    ft_serve_slow_at_step: int = field(
+        default=0,
+        metadata={"help": "Serving drill: stall the engine once before "
+                          "decode step k (0 = off) for "
+                          "ft_serve_slow_seconds — the wedged-dispatch "
+                          "drill for the serving stall watchdog (exit "
+                          "code 44). Env override: "
+                          "SCALETORCH_TPU_FT_SERVE_SLOW_STEP."},
+    )
+    ft_serve_slow_seconds: float = field(
+        default=30.0,
+        metadata={"help": "Duration of the injected ft_serve_slow_at_step "
+                          "stall. Env override: "
+                          "SCALETORCH_TPU_FT_SERVE_SLOW_SECONDS."},
+    )
+    ft_serve_submit_storm_at_step: int = field(
+        default=0,
+        metadata={"help": "Serving drill: inject a burst of "
+                          "ft_serve_submit_storm_count requests at decode "
+                          "step k (0 = off) — drives bounded admission "
+                          "and oldest-first shedding. Env override: "
+                          "SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_STEP."},
+    )
+    ft_serve_submit_storm_count: int = field(
+        default=8,
+        metadata={"help": "Number of requests the submit-storm drill "
+                          "injects. Env override: "
+                          "SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_COUNT."},
+    )
+    ft_serve_deadline_storm_at_step: int = field(
+        default=0,
+        metadata={"help": "Serving drill: force every in-flight request's "
+                          "deadline into the past at decode step k "
+                          "(0 = off) — drives the timeout paths at "
+                          "admission and mid-decode. Env override: "
+                          "SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP."},
+    )
 
     def __post_init__(self) -> None:
         if self.divergence_policy not in ("skip", "rollback", "abort"):
@@ -627,7 +680,10 @@ class ResilienceArguments:
         for name in ("max_consecutive_anomalies",
                      "max_rollbacks", "ft_nan_at_step", "ft_fail_saves",
                      "ft_sigterm_at_step", "ft_hang_at_step",
-                     "ft_bad_batch_at_step"):
+                     "ft_bad_batch_at_step", "ft_serve_nan_at_step",
+                     "ft_serve_nan_slot", "ft_serve_slow_at_step",
+                     "ft_serve_submit_storm_at_step",
+                     "ft_serve_deadline_storm_at_step"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"{name} must be >= 0, got {getattr(self, name)}")
@@ -644,6 +700,16 @@ class ResilienceArguments:
             raise ValueError(
                 f"ft_sigterm_host must be -1 (any host) or a process "
                 f"index, got {self.ft_sigterm_host}"
+            )
+        if self.ft_serve_slow_seconds <= 0:
+            raise ValueError(
+                f"ft_serve_slow_seconds must be > 0, "
+                f"got {self.ft_serve_slow_seconds}"
+            )
+        if self.ft_serve_submit_storm_count < 1:
+            raise ValueError(
+                f"ft_serve_submit_storm_count must be >= 1, "
+                f"got {self.ft_serve_submit_storm_count}"
             )
 
 

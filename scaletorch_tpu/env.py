@@ -104,3 +104,13 @@ register_env("SCALETORCH_TPU_FT_HANG_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_BAD_BATCH_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_HANG_TIMEOUT", "0", float)
 register_env("SCALETORCH_TPU_FT_COORDINATE", "1", _as_bool)
+# Serving fault injection (inference/resilience.ServingFaultInjector):
+# same present-wins contract over the ft_serve_* config fields; steps are
+# 1-based decode steps of the engine's lifetime.
+register_env("SCALETORCH_TPU_FT_SERVE_NAN_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_SERVE_NAN_SLOT", "0", int)
+register_env("SCALETORCH_TPU_FT_SERVE_SLOW_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_SERVE_SLOW_SECONDS", "30", float)
+register_env("SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_COUNT", "8", int)
+register_env("SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP", "0", int)

@@ -15,6 +15,11 @@ sharding specs, and attention math:
   * ``engine``    — continuous batching over a fixed-slot batch: admit
     queued requests into freed slots between decode steps (the jitted
     step never retraces), engine metrics riding the monitor plumbing.
+  * ``resilience`` — serving fault tolerance: the terminal-outcome
+    taxonomy (ok / timeout / shed / rejected / quarantined / aborted),
+    bounded admission + load shedding, non-finite quarantine, graceful
+    drain, the serving stall watchdog (exit code 44), and the
+    ``ServingFaultInjector`` driving hermetic end-to-end drills.
 """
 
 from scaletorch_tpu.inference.kv_cache import (  # noqa: F401
@@ -34,8 +39,16 @@ from scaletorch_tpu.inference.sampling import (  # noqa: F401
 )
 from scaletorch_tpu.inference.decode import (  # noqa: F401
     make_decode_step,
+    make_fill_slots_step,
     make_prefill_step,
     resolve_forward_cached,
+)
+from scaletorch_tpu.inference.resilience import (  # noqa: F401
+    SERVING_STALL_EXIT_CODE,
+    TERMINAL_OUTCOMES,
+    EngineDraining,
+    ServingFaultInjector,
+    make_serving_watchdog,
 )
 from scaletorch_tpu.inference.engine import (  # noqa: F401
     EngineMetrics,

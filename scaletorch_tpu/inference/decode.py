@@ -27,7 +27,12 @@ import jax
 import jax.numpy as jnp
 
 from scaletorch_tpu.inference.kv_cache import KVCache
-from scaletorch_tpu.inference.sampling import SamplingParams, sample, slot_keys
+from scaletorch_tpu.inference.sampling import (
+    SamplingParams,
+    finite_mask,
+    sample,
+    slot_keys,
+)
 
 
 def _resolve_donate(donate_cache: Optional[bool]) -> bool:
@@ -74,15 +79,19 @@ def make_prefill_step(
 
     prefill(params, tokens [B, P], lengths [B], write_mask [B] bool,
             cache, base_keys [B, 2])
-      -> (first_token [B] i32, last_logits [B, V] f32, new_cache)
+      -> (first_token [B] i32, last_logits [B, V] f32, finite [B] bool,
+          new_cache)
 
     Runs the full causal forward over the whole fixed buffer (positions
     [0, P) for every slot), writes cache [0, P) for masked slots only,
     reads each slot's logits at ``lengths - 1`` and samples its first
-    token. Anything the buffer holds beyond a slot's length writes
-    garbage K/V above the slot's live region — invisible, because the
-    j <= p attention mask never reaches past the current position and
-    decode overwrites position p before attending to it.
+    token. ``finite`` flags the slots whose sampled-from logits are all
+    finite (``sampling.finite_mask``) — the engine quarantines a False
+    slot instead of emitting its garbage sample. Anything the buffer
+    holds beyond a slot's length writes garbage K/V above the slot's
+    live region — invisible, because the j <= p attention mask never
+    reaches past the current position and decode overwrites position p
+    before attending to it.
     """
     fwd = forward_fn or resolve_forward_cached(cfg)
 
@@ -99,7 +108,8 @@ def make_prefill_step(
         )[:, 0, :]
         keys = slot_keys(base_keys, lengths - 1)
         first = sample(last, keys, sampling)
-        return first, last.astype(jnp.float32), KVCache(*new_cache)
+        return (first, last.astype(jnp.float32), finite_mask(last),
+                KVCache(*new_cache))
 
     return jax.jit(
         prefill, donate_argnums=(4,) if _resolve_donate(donate_cache) else ()
@@ -117,11 +127,15 @@ def make_decode_step(
 
     decode(params, tokens [B] i32, positions [B] i32, active [B] bool,
            cache, base_keys [B, 2])
-      -> (next_token [B] i32, logits [B, V] f32, new_cache)
+      -> (next_token [B] i32, logits [B, V] f32, finite [B] bool,
+          new_cache)
 
     Feeds each slot's current token at its absolute position (RoPE at
     that position), appends K/V at the position for ACTIVE slots only,
     and samples the next token with the slot's (seed, position) key.
+    ``finite`` is the in-step non-finite guard (``sampling.finite_mask``
+    over the step logits): a False slot carries NaN/Inf numerics — the
+    engine retires it as ``quarantined`` and never emits its sample.
     Inactive slots compute garbage that goes nowhere — their mask bit
     keeps their cache bytes intact and the engine ignores their sample.
     """
@@ -135,10 +149,40 @@ def make_decode_step(
         step_logits = logits[:, 0, :]
         keys = slot_keys(base_keys, positions)
         nxt = sample(step_logits, keys, sampling)
-        return nxt, step_logits.astype(jnp.float32), KVCache(*new_cache)
+        return (nxt, step_logits.astype(jnp.float32),
+                finite_mask(step_logits), KVCache(*new_cache))
 
     return jax.jit(
         decode, donate_argnums=(4,) if _resolve_donate(donate_cache) else ()
+    )
+
+
+def make_fill_slots_step(*, donate_cache: Optional[bool] = None) -> Callable:
+    """Build the jitted masked slot-fill over the stacked KV cache.
+
+    fill_slots(cache, mask [B] bool, value scalar) -> cache with every
+    masked slot's cache lines set to ``value`` along the batch axis
+    (axis 1 of the [L, B, Hkv, S_max, D] buffers); unmasked slots' bytes
+    pass through bit-identical.
+
+    One compile serves both consumers — quarantine hygiene (value 0:
+    a retired poison slot's NaN K/V must not outlive the request) and
+    fault injection (value NaN: poison one slot's cache so its next
+    decode step goes non-finite) — because the mask and the fill value
+    are data, never shapes. The cache is donated like the engine steps,
+    so XLA rewrites the masked lanes in place.
+    """
+
+    def fill_slots(cache, mask, value):
+        def fill(buf):
+            m = mask.reshape((1, mask.shape[0]) + (1,) * (buf.ndim - 2))
+            return jnp.where(m, jnp.asarray(value, buf.dtype), buf)
+
+        return KVCache(*(fill(buf) for buf in cache))
+
+    return jax.jit(
+        fill_slots,
+        donate_argnums=(0,) if _resolve_donate(donate_cache) else (),
     )
 
 

@@ -15,11 +15,22 @@ TPU execution discipline:
     with a mesh it is head-sharded over ``tp`` via the same specs the
     training params use (kv_cache_specs), and the steps run GSPMD.
 
+Serving-grade fault tolerance (inference/resilience.py) rides the same
+discipline: every submitted request ends in exactly one terminal
+``outcome`` (ok / timeout / shed / rejected / quarantined / aborted),
+admission is bounded (``queue_capacity`` sheds oldest-first), per-request
+TTL deadlines are checked at admission and every decode step, a slot
+whose logits go non-finite is quarantined (cache lines mask-cleared, the
+other slots keep serving, nothing retraces), and ``drain()`` stops
+admissions and finishes the in-flight work — wired to the training
+stack's ``PreemptionHandler`` for SIGTERM and to ``HangWatchdog`` via
+``make_serving_watchdog`` for stalled steps.
+
 Metrics ride the existing plumbing: ``EngineMetrics`` keeps the
 counters/gauges (tokens/s, time-to-first-token, queue depth, slot
-occupancy) and can sample them into a ``SystemMonitor`` ring buffer
-(utils/monitor.py) so a serving process's tail is diagnosable exactly
-like a training run's.
+occupancy, per-outcome counters, deadline-miss/quarantine rates) and can
+sample them into a ``SystemMonitor`` ring buffer (utils/monitor.py) so a
+serving process's tail is diagnosable exactly like a training run's.
 """
 
 from __future__ import annotations
@@ -28,7 +39,7 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,11 +47,17 @@ import numpy as np
 
 from scaletorch_tpu.inference.decode import (
     make_decode_step,
+    make_fill_slots_step,
     make_prefill_step,
 )
 from scaletorch_tpu.inference.kv_cache import (
     init_kv_cache,
     kv_cache_bytes,
+)
+from scaletorch_tpu.inference.resilience import (
+    TERMINAL_OUTCOMES,
+    EngineDraining,
+    ServingFaultInjector,
 )
 from scaletorch_tpu.inference.sampling import SamplingParams
 from scaletorch_tpu.utils.logger import get_logger
@@ -52,7 +69,9 @@ logger = get_logger(__name__)
 class Request:
     """One generation request. ``eos_id`` stops the slot early;
     ``max_new_tokens`` always bounds it; the engine's ``max_seq`` caps
-    prompt + generation regardless."""
+    prompt + generation regardless. ``deadline`` (absolute monotonic
+    time, or None) retires the request with ``timeout`` wherever it is
+    — queued or mid-decode — once passed."""
 
     request_id: int
     prompt: List[int]
@@ -60,14 +79,23 @@ class Request:
     eos_id: Optional[int] = None
     seed: int = 0
     submit_time: float = field(default_factory=time.monotonic)
+    deadline: Optional[float] = None
 
 
 @dataclass
 class RequestResult:
+    """The single terminal record of a request. ``outcome`` is one of
+    ``TERMINAL_OUTCOMES``; ``finish_reason`` refines an ``ok`` outcome
+    ('eos' | 'length' | 'max_seq') and repeats the outcome otherwise.
+    Non-ok outcomes carry whatever tokens were generated before the
+    fault (``tokens``) plus a human-readable ``detail``."""
+
     request_id: int
     prompt: List[int]
     tokens: List[int]               # generated tokens (prompt excluded)
-    finish_reason: str              # 'eos' | 'length' | 'max_seq'
+    finish_reason: str              # 'eos' | 'length' | 'max_seq' | outcome
+    outcome: str = "ok"             # one of TERMINAL_OUTCOMES
+    detail: Optional[str] = None    # non-ok outcomes: what happened
     ttft_s: Optional[float] = None  # submit -> first generated token
     latency_s: Optional[float] = None
 
@@ -76,10 +104,14 @@ class RequestResult:
 class EngineMetrics:
     """Serving health counters/gauges. ``snapshot()`` is flat numeric —
     ready for a MetricsLogger line or a SystemMonitor ring-buffer record
-    (``monitor.sample(counters=metrics.snapshot())``)."""
+    (``monitor.sample(counters=metrics.snapshot())``) — and lands in
+    serving crash reports via ``make_serving_watchdog``. The per-outcome
+    counters satisfy the conservation invariant
+    ``requests_submitted == sum(requests_<outcome>)`` once the engine
+    is drained."""
 
     requests_submitted: int = 0
-    requests_completed: int = 0
+    requests_completed: int = 0     # ok outcomes only
     tokens_generated: int = 0
     prefill_calls: int = 0
     decode_steps: int = 0
@@ -88,12 +120,19 @@ class EngineMetrics:
     num_slots: int = 0
     ttft_sum_s: float = 0.0
     ttft_count: int = 0
+    outcomes: Dict[str, int] = field(
+        default_factory=lambda: {o: 0 for o in TERMINAL_OUTCOMES})
     _window_start: float = field(default_factory=time.monotonic)
     _window_tokens: int = 0
 
     def record_ttft(self, ttft_s: float) -> None:
         self.ttft_sum_s += ttft_s
         self.ttft_count += 1
+
+    def record_outcome(self, outcome: str) -> None:
+        self.outcomes[outcome] += 1
+        if outcome == "ok":
+            self.requests_completed += 1
 
     def tokens_per_second(self) -> float:
         dt = time.monotonic() - self._window_start
@@ -104,7 +143,8 @@ class EngineMetrics:
         self._window_tokens = 0
 
     def snapshot(self) -> Dict[str, float]:
-        return {
+        terminal = sum(self.outcomes.values())
+        snap = {
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
             "tokens_generated": self.tokens_generated,
@@ -118,7 +158,16 @@ class EngineMetrics:
             "mean_ttft_s": (
                 self.ttft_sum_s / self.ttft_count if self.ttft_count else 0.0
             ),
+            "deadline_miss_rate": (
+                self.outcomes["timeout"] / terminal if terminal else 0.0
+            ),
+            "quarantine_rate": (
+                self.outcomes["quarantined"] / terminal if terminal else 0.0
+            ),
         }
+        for outcome, count in self.outcomes.items():
+            snap[f"requests_{outcome}"] = count
+        return snap
 
 
 class _Slot:
@@ -158,6 +207,25 @@ class InferenceEngine:
         mesh (KV heads over ``tp_axis``, slots over ``batch_axis``).
     monitor : optional SystemMonitor; ``step()`` samples the metrics
         snapshot into its ring buffer every ``monitor_every`` steps.
+    queue_capacity : bounded admission — with more than this many
+        requests queued, the OLDEST queued request is shed (terminal
+        outcome ``shed``). 0 (default) keeps the queue unbounded.
+    default_ttl_s : deadline applied to requests submitted without an
+        explicit ``ttl_s`` (0 = no deadline). Expired requests end as
+        ``timeout``, queued or mid-decode.
+    strict_submit : True (default) preserves raise-on-invalid
+        ``submit()``; False converts validation failures into a
+        structured ``rejected`` terminal result so one malformed
+        request cannot kill a server loop.
+    forward_fn : optional override of the model's cache-aware forward
+        (tests use it to simulate content-dependent poison requests).
+    injector : optional ``ServingFaultInjector`` driving hermetic
+        fault drills (NaN logits, slow decode, submit/deadline storms).
+    preemption : optional ``resilience.PreemptionHandler``; ``run()``
+        polls it each tick and responds to SIGTERM by draining.
+    watchdog : optional ``HangWatchdog`` (see ``make_serving_watchdog``);
+        ``step()`` beats it so a stalled tick fires the serving
+        crash-report path.
     """
 
     def __init__(
@@ -176,11 +244,28 @@ class InferenceEngine:
         donate_cache: Optional[bool] = None,
         monitor: Any = None,
         monitor_every: int = 16,
+        queue_capacity: int = 0,
+        default_ttl_s: float = 0.0,
+        strict_submit: bool = True,
+        forward_fn: Optional[Callable] = None,
+        injector: Optional[ServingFaultInjector] = None,
+        preemption: Any = None,
+        watchdog: Any = None,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         if max_seq < 2:
             raise ValueError(f"max_seq must be >= 2, got {max_seq}")
+        if queue_capacity < 0:
+            raise ValueError(
+                f"queue_capacity must be >= 0 (0 = unbounded), "
+                f"got {queue_capacity}"
+            )
+        if default_ttl_s < 0:
+            raise ValueError(
+                f"default_ttl_s must be >= 0 (0 = no deadline), "
+                f"got {default_ttl_s}"
+            )
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -193,6 +278,12 @@ class InferenceEngine:
         self.sampling = sampling
         self.monitor = monitor
         self.monitor_every = monitor_every
+        self.queue_capacity = queue_capacity
+        self.default_ttl_s = default_ttl_s
+        self.strict_submit = strict_submit
+        self.injector = injector
+        self.preemption = preemption
+        self.watchdog = watchdog
 
         sharding = None
         if mesh is not None:
@@ -211,15 +302,18 @@ class InferenceEngine:
         )
 
         self._prefill = make_prefill_step(
-            cfg, sampling, donate_cache=donate_cache)
+            cfg, sampling, forward_fn=forward_fn, donate_cache=donate_cache)
         self._decode = make_decode_step(
-            cfg, sampling, donate_cache=donate_cache)
+            cfg, sampling, forward_fn=forward_fn, donate_cache=donate_cache)
+        self._fill_slots = make_fill_slots_step(donate_cache=donate_cache)
 
         self._slots = [_Slot() for _ in range(max_slots)]
         self._queue: deque[Request] = deque()
         self._results: Dict[int, RequestResult] = {}
+        self._finished_tick: List[RequestResult] = []
         self._ids = itertools.count()
         self._base_keys = np.zeros((max_slots, 2), np.uint32)
+        self._draining = False
         self.metrics = EngineMetrics(num_slots=max_slots)
 
     # ---- compile accounting (the no-retrace contract) --------------------
@@ -231,6 +325,10 @@ class InferenceEngine:
     def prefill_compile_count(self) -> int:
         return self._prefill._cache_size()
 
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     # ---- request lifecycle ----------------------------------------------
     def submit(
         self,
@@ -239,34 +337,174 @@ class InferenceEngine:
         max_new_tokens: int = 64,
         eos_id: Optional[int] = None,
         seed: int = 0,
+        ttl_s: Optional[float] = None,
     ) -> int:
         """Queue a request; returns its id. Admission happens inside
-        ``step()`` when a slot frees up."""
-        if not prompt:
-            raise ValueError("prompt must contain at least one token")
-        if len(prompt) > self.prefill_len:
-            raise ValueError(
+        ``step()`` when a slot frees up.
+
+        ``ttl_s`` sets this request's deadline (None = engine
+        ``default_ttl_s``; <= 0 = no deadline). Invalid submissions
+        raise (``strict_submit=True``, the default) or end as a
+        ``rejected`` terminal result; submitting into a draining engine
+        raises ``EngineDraining`` / rejects the same way. A full queue
+        (``queue_capacity``) sheds the OLDEST queued request to make
+        room — under overload the freshest work survives, and the shed
+        request gets a ``shed`` terminal result instead of silently
+        rotting in an unbounded queue.
+        """
+        err = None
+        if self._draining:
+            err = "engine is draining: admissions are stopped"
+        elif not prompt:
+            err = "prompt must contain at least one token"
+        elif len(prompt) > self.prefill_len:
+            err = (
                 f"prompt length {len(prompt)} exceeds the engine's static "
                 f"prefill buffer ({self.prefill_len}); re-create the engine "
                 "with a larger prefill_len/max_seq"
             )
-        if len(prompt) >= self.max_seq:
-            raise ValueError(
+        elif len(prompt) >= self.max_seq:
+            err = (
                 f"prompt length {len(prompt)} leaves no room to generate "
                 f"within max_seq {self.max_seq}"
             )
+        if err is not None and self.strict_submit:
+            raise EngineDraining(err) if self._draining else ValueError(err)
         req = Request(
             request_id=next(self._ids), prompt=list(prompt),
             max_new_tokens=max_new_tokens, eos_id=eos_id, seed=seed,
         )
-        self._queue.append(req)
+        ttl = self.default_ttl_s if ttl_s is None else ttl_s
+        if ttl and ttl > 0:
+            req.deadline = req.submit_time + ttl
         self.metrics.requests_submitted += 1
+        if err is not None:
+            self._finalize(req, "rejected", tokens=[], detail=err,
+                           now=time.monotonic())
+            return req.request_id
+        self._queue.append(req)
+        while self.queue_capacity and len(self._queue) > self.queue_capacity:
+            shed = self._queue.popleft()
+            self._finalize(
+                shed, "shed", tokens=[],
+                detail=(f"queue exceeded capacity {self.queue_capacity}; "
+                        "oldest request shed"),
+                now=time.monotonic(),
+            )
         self.metrics.queue_depth = len(self._queue)
         return req.request_id
 
+    def _finalize(
+        self,
+        req: Request,
+        outcome: str,
+        *,
+        tokens: List[int],
+        reason: Optional[str] = None,
+        detail: Optional[str] = None,
+        ttft_t: Optional[float] = None,
+        now: float,
+    ) -> None:
+        """Record the single terminal result of ``req``. Every request
+        path funnels through here, so the conservation invariant
+        (submitted == sum over outcomes) holds by construction."""
+        self._results[req.request_id] = RequestResult(
+            request_id=req.request_id,
+            prompt=req.prompt,
+            tokens=tokens,
+            finish_reason=reason or outcome,
+            outcome=outcome,
+            detail=detail,
+            ttft_s=(ttft_t - req.submit_time) if ttft_t is not None else None,
+            latency_s=now - req.submit_time,
+        )
+        self._finished_tick.append(self._results[req.request_id])
+        self.metrics.record_outcome(outcome)
+        if outcome != "ok":
+            logger.warning(
+                "request %d -> %s%s", req.request_id, outcome,
+                f" ({detail})" if detail else "",
+            )
+
+    def _retire_slot(
+        self,
+        i: int,
+        outcome: str,
+        *,
+        reason: Optional[str] = None,
+        detail: Optional[str] = None,
+        now: float,
+    ) -> None:
+        """Terminal-result a slot's request (partial tokens attached)
+        and free the slot."""
+        slot = self._slots[i]
+        req = slot.request
+        self._finalize(
+            req, outcome, tokens=slot.tokens[len(req.prompt):],
+            reason=reason, detail=detail, ttft_t=slot.first_token_t, now=now,
+        )
+        slot.request = None
+        slot.tokens = []
+
+    def _expire(self, now: float) -> None:
+        """Deadline sweep: retire queued and mid-decode requests whose
+        deadline has passed with a ``timeout`` terminal result. Runs at
+        every tick — admission control AND each decode step see fresh
+        deadline state."""
+        if self._queue:
+            kept: deque[Request] = deque()
+            for req in self._queue:
+                if req.deadline is not None and now >= req.deadline:
+                    self._finalize(
+                        req, "timeout", tokens=[],
+                        detail="deadline exceeded before admission", now=now)
+                else:
+                    kept.append(req)
+            self._queue = kept
+            self.metrics.queue_depth = len(self._queue)
+        for i, slot in enumerate(self._slots):
+            if (slot.active and slot.request.deadline is not None
+                    and now >= slot.request.deadline):
+                self._retire_slot(
+                    i, "timeout", detail="deadline exceeded mid-decode",
+                    now=now)
+
+    def _quarantine(self, indices: List[int], now: float, where: str) -> None:
+        """Retire poisoned slots (non-finite logits) and mask-clear their
+        cache lines so the NaN K/V cannot outlive the request. The clear
+        is one jitted masked fill over the whole cache — data-only, so
+        the decode step's single compile survives the fault."""
+        mask = np.zeros(self.max_slots, bool)
+        for i in indices:
+            self._retire_slot(
+                i, "quarantined",
+                detail=f"non-finite logits at {where}", now=now)
+            mask[i] = True
+        self.cache = self._fill_slots(
+            self.cache, jnp.asarray(mask), jnp.asarray(0.0, jnp.float32))
+
+    def _poison_slot(self, slot_idx: int) -> None:
+        """Fault injection: NaN-fill one slot's cache lines so its next
+        decode step produces non-finite logits (same masked fill the
+        quarantine clear uses — one compile serves both)."""
+        active = [i for i, s in enumerate(self._slots) if s.active]
+        if not active:
+            logger.warning(
+                "fault injection: no active slot to poison; skipping")
+            return
+        if slot_idx not in active:
+            slot_idx = active[0]
+        mask = np.zeros(self.max_slots, bool)
+        mask[slot_idx] = True
+        self.cache = self._fill_slots(
+            self.cache, jnp.asarray(mask),
+            jnp.asarray(float("nan"), jnp.float32))
+
     def _admit(self) -> None:
         """Move queued requests into free slots and prefill them — ONE
-        batched prefill call regardless of how many were admitted."""
+        batched prefill call regardless of how many were admitted. A
+        slot whose prefill logits are non-finite (poison prompt) is
+        quarantined immediately; the other admitted slots proceed."""
         free = [i for i, s in enumerate(self._slots) if not s.active]
         if not free or not self._queue:
             return
@@ -290,16 +528,20 @@ class InferenceEngine:
             self._base_keys[i] = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32)
             admitted.append(i)
-        first, _logits, self.cache = self._prefill(
+        first, _logits, finite, self.cache = self._prefill(
             self.params, jnp.asarray(tokens), jnp.asarray(lengths),
             jnp.asarray(write_mask), self.cache, jnp.asarray(self._base_keys),
         )
         self.metrics.prefill_calls += 1
         now = time.monotonic()
         first = np.asarray(first)
+        finite = np.asarray(finite)
+        poisoned = [i for i in admitted if not finite[i]]
+        if poisoned:
+            self._quarantine(poisoned, now, where="prefill")
         for i in admitted:
-            slot = self._slots[i]
-            self._emit(i, int(first[i]), now)
+            if finite[i]:
+                self._emit(i, int(first[i]), now)
         self.metrics.queue_depth = len(self._queue)
 
     def _emit(self, i: int, token: int, now: float) -> None:
@@ -325,26 +567,42 @@ class InferenceEngine:
             # past the end of the cache
             reason = "max_seq"
         if reason is not None:
-            self._results[req.request_id] = RequestResult(
-                request_id=req.request_id,
-                prompt=req.prompt,
-                tokens=slot.tokens[len(req.prompt):],
-                finish_reason=reason,
-                ttft_s=slot.first_token_t - req.submit_time,
-                latency_s=now - req.submit_time,
-            )
-            self.metrics.requests_completed += 1
-            slot.request = None
-            slot.tokens = []
+            self._retire_slot(i, "ok", reason=reason, now=now)
 
     def step(self) -> List[RequestResult]:
-        """One engine tick: admit into freed slots (prefill), then one
-        decode step for the active slots. Returns results finished this
-        tick."""
-        before = {r for r in self._results}
+        """One engine tick: deadline sweep, admit into freed slots
+        (prefill), then one decode step for the active slots — with the
+        slots whose logits went non-finite quarantined instead of
+        emitting. Returns results that reached their terminal outcome
+        this tick."""
+        self._finished_tick.clear()
+        tick = self.metrics.decode_steps + 1  # the decode step this tick runs
+        if self.watchdog is not None:
+            self.watchdog.beat(step=self.metrics.decode_steps,
+                               phase="serve-step")
+        inj = self.injector
+        if inj is not None:
+            storm = inj.take_submit_storm(tick) if not self._draining else 0
+            for _ in range(storm):
+                self.submit([1], max_new_tokens=1)
+            if inj.take_deadline_storm(tick):
+                past = time.monotonic() - 1.0
+                for req in self._queue:
+                    req.deadline = past
+                for s in self._slots:
+                    if s.active:
+                        s.request.deadline = past
+        self._expire(time.monotonic())
         self._admit()
         active_idx = [i for i, s in enumerate(self._slots) if s.active]
         if active_idx:
+            if inj is not None:
+                poison = inj.take_nan_logits(tick)
+                if poison is not None:
+                    self._poison_slot(poison)
+                stall = inj.take_slow_decode(tick)
+                if stall > 0:
+                    time.sleep(stall)
             tokens = np.zeros(self.max_slots, np.int32)
             positions = np.zeros(self.max_slots, np.int32)
             active = np.zeros(self.max_slots, bool)
@@ -356,16 +614,21 @@ class InferenceEngine:
                 tokens[i] = slot.tokens[-1]
                 positions[i] = slot.position + slot.generated - 1
                 active[i] = True
-            nxt, _logits, self.cache = self._decode(
+            nxt, _logits, finite, self.cache = self._decode(
                 self.params, jnp.asarray(tokens), jnp.asarray(positions),
                 jnp.asarray(active), self.cache,
                 jnp.asarray(self._base_keys),
             )
             self.metrics.decode_steps += 1
             nxt = np.asarray(nxt)
+            finite = np.asarray(finite)
             now = time.monotonic()
+            poisoned = [i for i in active_idx if not finite[i]]
+            if poisoned:
+                self._quarantine(poisoned, now, where="decode")
             for i in active_idx:
-                self._emit(i, int(nxt[i]), now)
+                if finite[i]:
+                    self._emit(i, int(nxt[i]), now)
         self.metrics.active_slots = sum(s.active for s in self._slots)
         self.metrics.queue_depth = len(self._queue)
         if (
@@ -373,25 +636,92 @@ class InferenceEngine:
             and self.metrics.decode_steps % self.monitor_every == 0
         ):
             self.monitor.sample(counters=self.metrics.snapshot())
-        return [self._results[r] for r in self._results if r not in before]
+        finished, self._finished_tick = self._finished_tick, []
+        return finished
 
     @property
     def pending(self) -> int:
         return len(self._queue) + sum(s.active for s in self._slots)
 
+    def _abort_pending(self, detail: str) -> None:
+        """Terminal-result every in-flight request as ``aborted``
+        (partial tokens attached for admitted slots) — completed work is
+        never discarded, and no slot stays active past its request's
+        terminal result."""
+        now = time.monotonic()
+        while self._queue:
+            self._finalize(self._queue.popleft(), "aborted", tokens=[],
+                           detail=detail, now=now)
+        for i, slot in enumerate(self._slots):
+            if slot.active:
+                self._retire_slot(i, "aborted", detail=detail, now=now)
+        self.metrics.queue_depth = 0
+        self.metrics.active_slots = 0
+
     def run(self, max_steps: int = 100_000) -> Dict[int, RequestResult]:
         """Drive ``step()`` until queue and slots drain; returns all
-        results by request id."""
+        results by request id. On ``max_steps`` exhaustion the completed
+        results are RETURNED (never discarded) and the unfinished
+        requests end as ``aborted`` with their partial tokens. A pending
+        preemption request (SIGTERM via the ``preemption`` handler)
+        switches to ``drain()``: admissions stop, in-flight requests
+        finish, and the engine returns cleanly."""
+        steps = 0
+        while self.pending and steps < max_steps:
+            if self.preemption is not None and self.preemption.requested:
+                logger.warning(
+                    "preemption requested (signal %s): draining the engine",
+                    self.preemption.signum,
+                )
+                self.drain(max_steps=max_steps - steps)
+                return dict(self._results)
+            self.step()
+            steps += 1
+        if self.pending:
+            logger.warning(
+                "engine did not drain within %d steps: aborting %d "
+                "in-flight requests (completed results are returned)",
+                max_steps, self.pending,
+            )
+            self._abort_pending(f"run(max_steps={max_steps}) exhausted")
+        return dict(self._results)
+
+    def drain(
+        self,
+        *,
+        max_steps: int = 100_000,
+        finish_queued: bool = False,
+    ) -> Dict[int, RequestResult]:
+        """Graceful shutdown: stop admissions (``submit()`` now raises
+        ``EngineDraining`` / returns ``rejected``), finish the in-flight
+        (admitted) requests, and flush all results. Queued-but-never-
+        admitted requests are ``aborted`` immediately unless
+        ``finish_queued`` — a SIGTERM grace period has no room for
+        unbounded queue depth. Anything still unfinished after
+        ``max_steps`` is ``aborted`` with partials attached. Idempotent."""
+        self._draining = True
+        if not finish_queued:
+            now = time.monotonic()
+            while self._queue:
+                self._finalize(
+                    self._queue.popleft(), "aborted", tokens=[],
+                    detail="drain: not yet admitted", now=now)
+            self.metrics.queue_depth = 0
         steps = 0
         while self.pending and steps < max_steps:
             self.step()
             steps += 1
         if self.pending:
-            raise RuntimeError(
-                f"engine did not drain within {max_steps} steps "
-                f"({self.pending} requests still in flight)"
-            )
+            self._abort_pending(f"drain(max_steps={max_steps}) exhausted")
         return dict(self._results)
 
     def result(self, request_id: int) -> Optional[RequestResult]:
         return self._results.get(request_id)
+
+    def pop_result(self, request_id: int) -> Optional[RequestResult]:
+        """Remove and return a terminal result (None when absent or not
+        yet terminal). The engine retains every terminal record for
+        ``result()``/``run()`` otherwise — unbounded over a long-running
+        server's lifetime, so a serving loop should pop each result once
+        it has been delivered."""
+        return self._results.pop(request_id, None)

@@ -1,0 +1,270 @@
+"""Serving resilience: terminal outcomes, fault injection, stall watchdog.
+
+PRs 1-2 made *training* survivable (divergence sentinel, coordinated
+multi-host recovery, hang watchdog, exit-code contract); this module is
+the serving counterpart for ``InferenceEngine``. At fleet scale faults
+are the steady state, not the exception (PAPERS.md: collective
+communication at 100k+ GPUs), and a front door for millions of users
+cannot let one malformed request, one NaN'd batch slot, or one stalled
+decode step take the whole engine down. Three cooperating pieces:
+
+  * a **terminal-outcome taxonomy** — every submitted request ends in
+    exactly ONE of ``TERMINAL_OUTCOMES``; the engine maintains the
+    conservation invariant ``requests_submitted == sum(outcomes)`` so an
+    operator (or a test) can always account for every request:
+
+      - ``ok``          finished normally (eos / length / max_seq)
+      - ``timeout``     per-request deadline (TTL) exceeded, queued or
+                        mid-decode; partial tokens are attached
+      - ``shed``        dropped oldest-first by bounded admission when
+                        the queue exceeded ``queue_capacity``
+      - ``rejected``    failed validation at submit (over-long prompt,
+                        empty prompt, draining engine) under
+                        ``strict_submit=False``
+      - ``quarantined`` the slot's logits went non-finite (a poison
+                        request / bad numerics); the slot is retired,
+                        its cache lines mask-cleared, and the engine
+                        keeps serving the other slots
+      - ``aborted``     the engine gave up externally: ``run(max_steps)``
+                        exhausted, or ``drain()`` retired it
+
+  * a ``ServingFaultInjector`` — config/env-driven serving faults
+    (NaN logits at a decode step, a slow decode stall, a submit storm,
+    a deadline storm) mirroring the training ``FaultInjector`` so the
+    recovery paths are exercised by hermetic end-to-end tests.
+
+  * ``make_serving_watchdog`` — the existing ``HangWatchdog`` pointed at
+    the engine: a stalled ``step()`` dumps thread stacks plus the engine
+    metrics snapshot to a crash report (``write_crash_report``) and
+    exits ``SERVING_STALL_EXIT_CODE`` (44), extending the 0/42/43/130
+    contract documented in docs/fault_tolerance.md.
+
+Graceful drain lives on the engine itself (``InferenceEngine.drain``),
+wired to the training stack's ``PreemptionHandler`` so SIGTERM follows
+the same stop-at-the-next-boundary discipline as a training run.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from scaletorch_tpu.resilience_distributed import (
+    SERVING_STALL_EXIT_CODE,
+    HangWatchdog,
+    write_crash_report,
+)
+from scaletorch_tpu.utils.logger import get_logger
+
+__all__ = [
+    "TERMINAL_OUTCOMES",
+    "EngineDraining",
+    "ServingFaultInjector",
+    "SERVING_STALL_EXIT_CODE",
+    "make_serving_watchdog",
+]
+
+# Every submitted request ends in exactly one of these (RequestResult
+# .outcome); the engine's conservation invariant sums over them.
+TERMINAL_OUTCOMES = (
+    "ok", "timeout", "shed", "rejected", "quarantined", "aborted",
+)
+
+
+class EngineDraining(RuntimeError):
+    """Raised by ``submit()`` (strict mode) once ``drain()`` has stopped
+    admissions — the serving loop is shutting down."""
+
+
+# --------------------------------------------------------------------------
+# Fault injection
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class ServingFaultInjector:
+    """Config/env-driven serving fault hooks. All knobs default to off (0).
+
+    Steps are DECODE steps, 1-based: ``at_step == k`` fires on the tick
+    that runs the k-th decode step of the engine's lifetime.
+
+    * ``nan_logits_at_step`` / ``nan_logits_slot`` — before decode step
+      k, fill slot ``nan_logits_slot``'s KV-cache lines with NaN so its
+      logits go non-finite that step (a poison request), driving the
+      quarantine path. The write is a masked device op
+      (``make_fill_slots_step``) — data changes, no retrace.
+    * ``slow_decode_at_step`` / ``slow_decode_seconds`` — stall the
+      engine once before decode step k, simulating a wedged device
+      dispatch for the serving watchdog.
+    * ``submit_storm_at_step`` / ``submit_storm_count`` — inject a burst
+      of n one-token requests at step k, driving bounded admission and
+      oldest-first shedding.
+    * ``deadline_storm_at_step`` — force every in-flight request's
+      deadline (queued and mid-decode) into the past at step k, driving
+      the ``timeout`` paths at admission and decode.
+
+    Env overrides (present-wins, the ``env.env_override`` contract
+    shared with the training ``FaultInjector``):
+    ``SCALETORCH_TPU_FT_SERVE_NAN_STEP``, ``.._SERVE_NAN_SLOT``,
+    ``.._SERVE_SLOW_STEP``, ``.._SERVE_SLOW_SECONDS``,
+    ``.._SERVE_SUBMIT_STORM_STEP``, ``.._SERVE_SUBMIT_STORM_COUNT``,
+    ``.._SERVE_DEADLINE_STORM_STEP``.
+    """
+
+    nan_logits_at_step: int = 0
+    nan_logits_slot: int = 0
+    slow_decode_at_step: int = 0
+    slow_decode_seconds: float = 30.0
+    submit_storm_at_step: int = 0
+    submit_storm_count: int = 8
+    deadline_storm_at_step: int = 0
+    _nan_fired: bool = field(default=False, repr=False)
+    _slow_fired: bool = field(default=False, repr=False)
+    _storm_fired: bool = field(default=False, repr=False)
+    _deadline_fired: bool = field(default=False, repr=False)
+
+    @classmethod
+    def from_config(cls, cfg) -> "ServingFaultInjector":
+        from scaletorch_tpu.env import env_override
+
+        def env_or(name: str, cfg_field: str, default):
+            return env_override(name, getattr(cfg, cfg_field, default))
+
+        return cls(
+            nan_logits_at_step=int(env_or(
+                "SCALETORCH_TPU_FT_SERVE_NAN_STEP",
+                "ft_serve_nan_at_step", 0)),
+            nan_logits_slot=int(env_or(
+                "SCALETORCH_TPU_FT_SERVE_NAN_SLOT",
+                "ft_serve_nan_slot", 0)),
+            slow_decode_at_step=int(env_or(
+                "SCALETORCH_TPU_FT_SERVE_SLOW_STEP",
+                "ft_serve_slow_at_step", 0)),
+            slow_decode_seconds=float(env_or(
+                "SCALETORCH_TPU_FT_SERVE_SLOW_SECONDS",
+                "ft_serve_slow_seconds", 30.0)),
+            submit_storm_at_step=int(env_or(
+                "SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_STEP",
+                "ft_serve_submit_storm_at_step", 0)),
+            submit_storm_count=int(env_or(
+                "SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_COUNT",
+                "ft_serve_submit_storm_count", 8)),
+            deadline_storm_at_step=int(env_or(
+                "SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP",
+                "ft_serve_deadline_storm_at_step", 0)),
+        )
+
+    @property
+    def active(self) -> bool:
+        return bool(self.nan_logits_at_step or self.slow_decode_at_step
+                    or self.submit_storm_at_step
+                    or self.deadline_storm_at_step)
+
+    def take_nan_logits(self, step: int) -> Optional[int]:
+        """Slot index to poison before decode step ``step``, or None."""
+        if self.nan_logits_at_step and step == self.nan_logits_at_step \
+                and not self._nan_fired:
+            self._nan_fired = True
+            get_logger().warning(
+                f"serving fault injection: NaN logits in slot "
+                f"{self.nan_logits_slot} at decode step {step}"
+            )
+            return max(0, self.nan_logits_slot)
+        return None
+
+    def take_slow_decode(self, step: int) -> float:
+        """Seconds to stall before decode step ``step`` (0 = no stall)."""
+        if self.slow_decode_at_step and step == self.slow_decode_at_step \
+                and not self._slow_fired:
+            self._slow_fired = True
+            get_logger().warning(
+                f"serving fault injection: stalling {self.slow_decode_seconds:g}s "
+                f"before decode step {step}"
+            )
+            return self.slow_decode_seconds
+        return 0.0
+
+    def take_submit_storm(self, step: int) -> int:
+        """Number of storm requests to inject at step ``step``."""
+        if self.submit_storm_at_step and step == self.submit_storm_at_step \
+                and not self._storm_fired:
+            self._storm_fired = True
+            get_logger().warning(
+                f"serving fault injection: submit storm of "
+                f"{self.submit_storm_count} requests at decode step {step}"
+            )
+            return max(0, self.submit_storm_count)
+        return 0
+
+    def take_deadline_storm(self, step: int) -> bool:
+        """True when every in-flight deadline must be forced expired."""
+        if self.deadline_storm_at_step \
+                and step == self.deadline_storm_at_step \
+                and not self._deadline_fired:
+            self._deadline_fired = True
+            get_logger().warning(
+                f"serving fault injection: deadline storm at decode "
+                f"step {step}"
+            )
+            return True
+        return False
+
+
+# --------------------------------------------------------------------------
+# Serving stall watchdog
+# --------------------------------------------------------------------------
+
+
+def make_serving_watchdog(
+    engine,
+    timeout: float,
+    *,
+    crash_report_dir: str = "results",
+    exit_fn: Callable[[int], None] = os._exit,
+    attach: bool = True,
+) -> HangWatchdog:
+    """A ``HangWatchdog`` pointed at an ``InferenceEngine``.
+
+    ``engine.step()`` beats the watchdog each tick; a ``step()`` that
+    stalls past ``timeout`` seconds (a wedged device dispatch, a dead
+    collective on a sharded serving mesh) dumps every thread stack plus
+    the engine's metrics snapshot — including the per-outcome counters,
+    so the post-mortem shows what the engine had admitted/shed/
+    quarantined when it died — to ``crash_report_dir`` and exits
+    ``SERVING_STALL_EXIT_CODE`` (44). Same fire-dump-exit discipline,
+    crash-report plumbing, and launcher contract as the training
+    watchdog; tests inject a recording ``exit_fn``.
+
+    With ``attach`` (default) the watchdog is installed as
+    ``engine.watchdog`` so ``step()`` beats it; the caller still owns
+    start/stop (``with make_serving_watchdog(...):``).
+    """
+
+    def _report(info: dict) -> None:
+        monitor = getattr(engine, "monitor", None)
+        write_crash_report(
+            info.get("reason", "serving stall watchdog fired"),
+            engine.metrics.decode_steps,
+            directory=crash_report_dir,
+            counters=engine.metrics.snapshot(),
+            monitor_records=(
+                list(monitor.records) if monitor is not None else None
+            ),
+            thread_stacks=info.get("thread_stacks"),
+            extra={
+                "serving": True,
+                "exit_code": SERVING_STALL_EXIT_CODE,
+                "pending_requests": engine.pending,
+            },
+        )
+
+    wd = HangWatchdog(
+        timeout,
+        crash_report=_report,
+        exit_fn=exit_fn,
+        exit_code=SERVING_STALL_EXIT_CODE,
+    )
+    if attach:
+        engine.watchdog = wd
+    return wd

@@ -88,6 +88,17 @@ def sample(
     return jax.vmap(lambda l, k: sample_one(l, k, params))(logits, keys)
 
 
+def finite_mask(logits: jax.Array) -> jax.Array:
+    """[..., V] logits -> [...] bool: True where every vocab entry is
+    finite. The serving counterpart of the train step's in-jit
+    ``nonfinite_guard``: both engine steps compute it on the logits they
+    sample from, so a slot whose numerics went NaN/Inf (a poison
+    request) is flagged INSIDE the compiled step — the engine
+    quarantines it without retracing and without a speculative host
+    round-trip per token."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)
+
+
 def slot_keys(base_keys: jax.Array, positions: jax.Array) -> jax.Array:
     """Per-step keys: fold each slot's position into its request seed —
     the (seed, position) pair makes every emitted token's randomness

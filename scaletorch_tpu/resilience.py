@@ -23,6 +23,14 @@ Four cooperating pieces:
     fail the first n save attempts, deliver a simulated SIGTERM) so the
     recovery paths are exercised by hermetic end-to-end tests instead of
     waiting for production to exercise them first.
+
+The SERVING counterpart lives in ``inference/resilience.py``: the
+terminal-outcome taxonomy, ``ServingFaultInjector`` (NaN logits, slow
+decode, submit/deadline storms — ``SCALETORCH_TPU_FT_SERVE_*`` env
+parity with the knobs here), and the serving stall watchdog. The engine
+reuses this module's ``PreemptionHandler`` for SIGTERM-driven drain, so
+training and serving follow the same stop-at-the-next-boundary
+discipline.
 """
 
 from __future__ import annotations

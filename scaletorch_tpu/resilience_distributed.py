@@ -34,6 +34,9 @@ by scripts/launch_multihost.sh):
   * 42  — ``TrainingDivergedError`` (sentinel abort / budget exhausted)
   * 43  — hang watchdog fired (restartable: state is on disk up to the
           last periodic/emergency checkpoint)
+  * 44  — SERVING stall watchdog fired (inference/resilience.py
+          ``make_serving_watchdog``: a wedged ``InferenceEngine.step()``;
+          restartable — the engine holds no durable state)
   * 130 — operator KeyboardInterrupt
 """
 
@@ -58,6 +61,8 @@ from scaletorch_tpu.utils.logger import get_logger
 
 DIVERGED_EXIT_CODE = 42
 WATCHDOG_EXIT_CODE = 43
+# a wedged InferenceEngine.step() (serving watchdog, inference/resilience.py)
+SERVING_STALL_EXIT_CODE = 44
 
 
 # --------------------------------------------------------------------------
