@@ -601,6 +601,21 @@ class ResilienceArguments:
                           "retry + skip-and-log path. Env override: "
                           "SCALETORCH_TPU_FT_BAD_BATCH_STEP."},
     )
+    ft_slow_step_at_step: int = field(
+        default=0,
+        metadata={"help": "Telemetry drill: stall optimizer step k at "
+                          "its boundary for ft_slow_step_seconds "
+                          "(0 = off; fires once) so the slow-step "
+                          "detector arms an anomaly-triggered profiler "
+                          "window (telemetry/profiling.py). Env "
+                          "override: SCALETORCH_TPU_FT_SLOW_STEP_STEP."},
+    )
+    ft_slow_step_seconds: float = field(
+        default=0.5,
+        metadata={"help": "Duration of the injected ft_slow_step_at_step "
+                          "stall. Env override: "
+                          "SCALETORCH_TPU_FT_SLOW_STEP_SECONDS."},
+    )
     # Serving fault injection (inference.resilience.ServingFaultInjector;
     # steps are 1-based DECODE steps of the engine's lifetime)
     ft_serve_nan_at_step: int = field(
@@ -680,7 +695,8 @@ class ResilienceArguments:
         for name in ("max_consecutive_anomalies",
                      "max_rollbacks", "ft_nan_at_step", "ft_fail_saves",
                      "ft_sigterm_at_step", "ft_hang_at_step",
-                     "ft_bad_batch_at_step", "ft_serve_nan_at_step",
+                     "ft_bad_batch_at_step", "ft_slow_step_at_step",
+                     "ft_serve_nan_at_step",
                      "ft_serve_nan_slot", "ft_serve_slow_at_step",
                      "ft_serve_submit_storm_at_step",
                      "ft_serve_deadline_storm_at_step"):
@@ -701,6 +717,11 @@ class ResilienceArguments:
                 f"ft_sigterm_host must be -1 (any host) or a process "
                 f"index, got {self.ft_sigterm_host}"
             )
+        if self.ft_slow_step_seconds <= 0:
+            raise ValueError(
+                f"ft_slow_step_seconds must be > 0, "
+                f"got {self.ft_slow_step_seconds}"
+            )
         if self.ft_serve_slow_seconds <= 0:
             raise ValueError(
                 f"ft_serve_slow_seconds must be > 0, "
@@ -714,9 +735,143 @@ class ResilienceArguments:
 
 
 @dataclass
+class TelemetryArguments:
+    """Observability knobs (scaletorch_tpu/telemetry/): span tracing,
+    anomaly-triggered profiling, straggler detection, JSONL export.
+    Everything except straggler detection is enabled by setting
+    ``telemetry_dir`` (env override SCALETORCH_TPU_TELEMETRY_DIR,
+    present-wins — an explicitly empty value cancels it); stragglers
+    ride the existing multi-host decision gather and need no
+    directory."""
+
+    telemetry_dir: Optional[str] = field(
+        default=None,
+        metadata={"help": "Enable telemetry and write its artifacts here: "
+                          "trace_proc<N>.trace.json (Chrome trace events, "
+                          "Perfetto-loadable host-side spans), "
+                          "events_proc<N>.jsonl (schema-versioned metrics "
+                          "stream), profiles/ (jax.profiler captures), "
+                          "live_snapshot_<n>.json (SIGUSR1 dumps). Unset "
+                          "= telemetry off (instrumentation costs one "
+                          "branch per site). Env override: "
+                          "SCALETORCH_TPU_TELEMETRY_DIR."},
+    )
+    trace_max_events: int = field(
+        default=200_000,
+        metadata={"help": "Cap on span events written to the trace file "
+                          "(week-long runs stay disk-bounded; the drop "
+                          "count is reported, and the in-memory tail for "
+                          "crash reports keeps the NEWEST events "
+                          "regardless)."},
+    )
+    span_tail_size: int = field(
+        default=256,
+        metadata={"help": "Span events retained in memory for crash "
+                          "reports and SIGUSR1 live snapshots."},
+    )
+    profile_on_slow_step: float = field(
+        default=0.0,
+        metadata={"help": "Arm a bounded jax.profiler window when a "
+                          "step's wall time exceeds this factor x its "
+                          "EMA (0 = off; must be > 1 otherwise). "
+                          "Requires telemetry_dir."},
+    )
+    profile_window_steps: int = field(
+        default=3,
+        metadata={"help": "Steps each anomaly-triggered profiler window "
+                          "captures."},
+    )
+    profile_max_captures: int = field(
+        default=1,
+        metadata={"help": "Maximum anomaly-triggered profiler windows per "
+                          "run (a persistently slow run must not fill "
+                          "the disk with profiles)."},
+    )
+    profile_steps: str = field(
+        default="",
+        metadata={"help": "Manual profiler window 'start:stop' (steps; "
+                          "[start, stop), 1-based): capture these steps "
+                          "regardless of the slow-step detector. Env "
+                          "override: SCALETORCH_TPU_PROFILE_STEPS."},
+    )
+    straggler_factor: float = field(
+        default=2.0,
+        metadata={"help": "Flag a host as a straggler when its step wall "
+                          "time stays above this factor x the fleet "
+                          "median (0 = off; must be > 1 otherwise). "
+                          "Multi-host only; observations ride the "
+                          "existing per-step coordination gather — zero "
+                          "new collectives."},
+    )
+    straggler_patience: int = field(
+        default=3,
+        metadata={"help": "Consecutive over-threshold observations before "
+                          "a host is flagged (raises the straggler_flags "
+                          "counter and logs the host index)."},
+    )
+
+    def __post_init__(self) -> None:
+        if self.profile_on_slow_step != 0 and self.profile_on_slow_step <= 1.0:
+            raise ValueError(
+                "profile_on_slow_step must be 0 (off) or > 1 (spike when "
+                f"step_time > factor * EMA), got {self.profile_on_slow_step}"
+            )
+        if self.profile_window_steps < 1:
+            raise ValueError(
+                f"profile_window_steps must be >= 1, "
+                f"got {self.profile_window_steps}"
+            )
+        if self.profile_max_captures < 0:
+            raise ValueError(
+                f"profile_max_captures must be >= 0, "
+                f"got {self.profile_max_captures}"
+            )
+        if self.profile_steps:
+            from scaletorch_tpu.telemetry.profiling import parse_profile_steps
+
+            parse_profile_steps(self.profile_steps)  # raises on bad spec
+        if self.profile_on_slow_step or self.profile_steps:
+            # profiling captures land under the telemetry dir — without
+            # one the knob would be a silent no-op and the operator would
+            # wait forever for a window that never arms
+            from scaletorch_tpu.telemetry import telemetry_dir_from_config
+
+            if telemetry_dir_from_config(self) is None:
+                raise ValueError(
+                    "profile_on_slow_step / profile_steps need a telemetry "
+                    "directory to write captures into: set --telemetry_dir "
+                    "(or SCALETORCH_TPU_TELEMETRY_DIR)"
+                )
+        if self.straggler_factor != 0 and self.straggler_factor <= 1.0:
+            raise ValueError(
+                "straggler_factor must be 0 (off) or > 1 (flag when "
+                f"step_time > factor * median), got {self.straggler_factor}"
+            )
+        if self.straggler_patience < 1:
+            raise ValueError(
+                f"straggler_patience must be >= 1, "
+                f"got {self.straggler_patience}"
+            )
+        if self.trace_max_events < 1 or self.span_tail_size < 1:
+            raise ValueError(
+                "trace_max_events and span_tail_size must be >= 1, got "
+                f"{self.trace_max_events} / {self.span_tail_size}"
+            )
+
+
+@dataclass
 class LoggingArguments:
     log_frequency: int = 1
     log_file: Optional[str] = None
+    log_format: str = field(
+        default="text",
+        metadata={"help": "text | json — console/file log format. 'json' "
+                          "emits one JSON object per line (metrics step "
+                          "records as-is with ts/level/proc added, plain "
+                          "messages wrapped as {'msg': ...}) so fleet "
+                          "log aggregation never parses the "
+                          "' | '-joined human lines."},
+    )
     performance_log_dir: Optional[str] = field(
         default=None,
         metadata={"help": "Dump the per-step metrics history as JSON here at "
@@ -745,6 +900,7 @@ class ScaleTorchTPUArguments(
     TrainingArguments,
     CheckpointArguments,
     ResilienceArguments,
+    TelemetryArguments,
     LoggingArguments,
 ):
     """All training arguments, composed (reference config.py:393-403)."""
@@ -754,6 +910,11 @@ class ScaleTorchTPUArguments(
         DistributedArguments.__post_init__(self)
         CheckpointArguments.__post_init__(self)
         ResilienceArguments.__post_init__(self)
+        TelemetryArguments.__post_init__(self)
+        if self.log_format not in ("text", "json"):
+            raise ValueError(
+                f"log_format must be 'text' or 'json', got {self.log_format!r}"
+            )
         for name in ("data_read_retries", "data_max_skipped_batches"):
             if getattr(self, name) < 0:
                 raise ValueError(

@@ -95,6 +95,10 @@ register_env("SCALETORCH_TPU_FLASH_BLOCK_KV", "512", int)
 register_env("SCALETORCH_TPU_FT_NAN_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_FAIL_SAVES", "0", int)
 register_env("SCALETORCH_TPU_FT_SIGTERM_STEP", "0", int)
+# Telemetry drill: stall one optimizer step at the boundary so the
+# slow-step detector (telemetry/profiling.py) arms a profiler window.
+register_env("SCALETORCH_TPU_FT_SLOW_STEP_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_SLOW_STEP_SECONDS", "0.5", float)
 # Multi-host resilience (resilience_distributed.py): restrict the SIGTERM
 # drill to one host, inject a step-boundary stall, corrupt one data-stream
 # read, tune the hang watchdog, and toggle cross-host decision
@@ -114,3 +118,7 @@ register_env("SCALETORCH_TPU_FT_SERVE_SLOW_SECONDS", "30", float)
 register_env("SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_COUNT", "8", int)
 register_env("SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP", "0", int)
+# Telemetry (scaletorch_tpu/telemetry/): present-wins over the config
+# fields (an explicitly EMPTY dir cancels a config-armed telemetry run).
+register_env("SCALETORCH_TPU_TELEMETRY_DIR", "", str)
+register_env("SCALETORCH_TPU_PROFILE_STEPS", "", str)

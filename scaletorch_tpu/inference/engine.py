@@ -60,6 +60,7 @@ from scaletorch_tpu.inference.resilience import (
     ServingFaultInjector,
 )
 from scaletorch_tpu.inference.sampling import SamplingParams
+from scaletorch_tpu.telemetry.spans import NOOP_SPAN
 from scaletorch_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -207,6 +208,15 @@ class InferenceEngine:
         mesh (KV heads over ``tp_axis``, slots over ``batch_axis``).
     monitor : optional SystemMonitor; ``step()`` samples the metrics
         snapshot into its ring buffer every ``monitor_every`` steps.
+    tracer : optional ``telemetry.SpanTracer``; each tick records
+        ``tick`` / ``admission`` / ``prefill`` / ``decode`` spans (host
+        dispatch time — never a device sync; the vocabulary matches the
+        serving watchdog's beat phases). None = one branch per site.
+    exporter : optional ``telemetry.TelemetryExporter``; metrics
+        snapshots ride the same schema-versioned JSONL stream the
+        trainer's step records use (kind ``engine_metrics``) on the
+        ``monitor_every`` cadence and at drain/run exit — durable
+        serving metrics, not just the in-memory ring buffer.
     queue_capacity : bounded admission — with more than this many
         requests queued, the OLDEST queued request is shed (terminal
         outcome ``shed``). 0 (default) keeps the queue unbounded.
@@ -244,6 +254,8 @@ class InferenceEngine:
         donate_cache: Optional[bool] = None,
         monitor: Any = None,
         monitor_every: int = 16,
+        tracer: Any = None,
+        exporter: Any = None,
         queue_capacity: int = 0,
         default_ttl_s: float = 0.0,
         strict_submit: bool = True,
@@ -278,6 +290,8 @@ class InferenceEngine:
         self.sampling = sampling
         self.monitor = monitor
         self.monitor_every = monitor_every
+        self.tracer = tracer
+        self.exporter = exporter
         self.queue_capacity = queue_capacity
         self.default_ttl_s = default_ttl_s
         self.strict_submit = strict_submit
@@ -315,6 +329,39 @@ class InferenceEngine:
         self._base_keys = np.zeros((max_slots, 2), np.uint32)
         self._draining = False
         self.metrics = EngineMetrics(num_slots=max_slots)
+        # progress fingerprint of the last JSONL export: an idle engine
+        # polled at a cadence multiple (or a drain() straight after
+        # run()) must not append duplicate records — but any outcome
+        # movement (e.g. a queued request timing out on an idle tick)
+        # still must
+        self._exported_key = self._export_key()
+
+    def _span(self, name: str, **args):
+        """Telemetry span when a tracer is attached, shared no-op
+        otherwise (one branch; spans time HOST dispatch, never a device
+        sync — the telemetry/spans.py contract)."""
+        if self.tracer is None:
+            return NOOP_SPAN
+        return self.tracer.span(name, **args)
+
+    def _export_key(self):
+        """Progress fingerprint for JSONL export dedup (counters only —
+        snapshot() itself has wall-clock-derived rates that differ on
+        every call)."""
+        return (
+            self.metrics.decode_steps,
+            self.metrics.requests_submitted,
+            tuple(sorted(self.metrics.outcomes.items())),
+        )
+
+    def _export_snapshot(self) -> None:
+        """Append a metrics record to the JSONL stream iff progress was
+        made since the last export."""
+        key = self._export_key()
+        if key == self._exported_key:
+            return
+        self._exported_key = key
+        self.exporter.emit("engine_metrics", self.metrics.snapshot())
 
     # ---- compile accounting (the no-retrace contract) --------------------
     @property
@@ -528,10 +575,12 @@ class InferenceEngine:
             self._base_keys[i] = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32)
             admitted.append(i)
-        first, _logits, finite, self.cache = self._prefill(
-            self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-            jnp.asarray(write_mask), self.cache, jnp.asarray(self._base_keys),
-        )
+        with self._span("prefill", admitted=len(admitted)):
+            first, _logits, finite, self.cache = self._prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                jnp.asarray(write_mask), self.cache,
+                jnp.asarray(self._base_keys),
+            )
         self.metrics.prefill_calls += 1
         now = time.monotonic()
         first = np.asarray(first)
@@ -574,7 +623,8 @@ class InferenceEngine:
         (prefill), then one decode step for the active slots — with the
         slots whose logits went non-finite quarantined instead of
         emitting. Returns results that reached their terminal outcome
-        this tick."""
+        this tick. With a tracer attached the tick records ``tick`` /
+        ``admission`` / ``prefill`` / ``decode`` spans."""
         self._finished_tick.clear()
         tick = self.metrics.decode_steps + 1  # the decode step this tick runs
         if self.watchdog is not None:
@@ -592,50 +642,60 @@ class InferenceEngine:
                 for s in self._slots:
                     if s.active:
                         s.request.deadline = past
-        self._expire(time.monotonic())
-        self._admit()
-        active_idx = [i for i, s in enumerate(self._slots) if s.active]
-        if active_idx:
-            if inj is not None:
-                poison = inj.take_nan_logits(tick)
-                if poison is not None:
-                    self._poison_slot(poison)
-                stall = inj.take_slow_decode(tick)
-                if stall > 0:
-                    time.sleep(stall)
-            tokens = np.zeros(self.max_slots, np.int32)
-            positions = np.zeros(self.max_slots, np.int32)
-            active = np.zeros(self.max_slots, bool)
-            for i in active_idx:
-                slot = self._slots[i]
-                # feed the last emitted token at its absolute position:
-                # the prompt occupies [0, len), generated token g sits at
-                # len + g - 1
-                tokens[i] = slot.tokens[-1]
-                positions[i] = slot.position + slot.generated - 1
-                active[i] = True
-            nxt, _logits, finite, self.cache = self._decode(
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(active), self.cache,
-                jnp.asarray(self._base_keys),
-            )
-            self.metrics.decode_steps += 1
-            nxt = np.asarray(nxt)
-            finite = np.asarray(finite)
-            now = time.monotonic()
-            poisoned = [i for i in active_idx if not finite[i]]
-            if poisoned:
-                self._quarantine(poisoned, now, where="decode")
-            for i in active_idx:
-                if finite[i]:
-                    self._emit(i, int(nxt[i]), now)
+        with self._span("tick", tick=tick):
+            with self._span("admission"):
+                self._expire(time.monotonic())
+                self._admit()
+            active_idx = [i for i, s in enumerate(self._slots) if s.active]
+            if active_idx:
+                if inj is not None:
+                    poison = inj.take_nan_logits(tick)
+                    if poison is not None:
+                        self._poison_slot(poison)
+                    stall = inj.take_slow_decode(tick)
+                    if stall > 0:
+                        time.sleep(stall)
+                tokens = np.zeros(self.max_slots, np.int32)
+                positions = np.zeros(self.max_slots, np.int32)
+                active = np.zeros(self.max_slots, bool)
+                for i in active_idx:
+                    slot = self._slots[i]
+                    # feed the last emitted token at its absolute position:
+                    # the prompt occupies [0, len), generated token g sits at
+                    # len + g - 1
+                    tokens[i] = slot.tokens[-1]
+                    positions[i] = slot.position + slot.generated - 1
+                    active[i] = True
+                with self._span("decode", active=len(active_idx)):
+                    nxt, _logits, finite, self.cache = self._decode(
+                        self.params, jnp.asarray(tokens),
+                        jnp.asarray(positions),
+                        jnp.asarray(active), self.cache,
+                        jnp.asarray(self._base_keys),
+                    )
+                self.metrics.decode_steps += 1
+                nxt = np.asarray(nxt)
+                finite = np.asarray(finite)
+                now = time.monotonic()
+                poisoned = [i for i in active_idx if not finite[i]]
+                if poisoned:
+                    self._quarantine(poisoned, now, where="decode")
+                for i in active_idx:
+                    if finite[i]:
+                        self._emit(i, int(nxt[i]), now)
         self.metrics.active_slots = sum(s.active for s in self._slots)
         self.metrics.queue_depth = len(self._queue)
         if (
-            self.monitor is not None
+            (self.monitor is not None or self.exporter is not None)
             and self.metrics.decode_steps % self.monitor_every == 0
         ):
-            self.monitor.sample(counters=self.metrics.snapshot())
+            if self.monitor is not None:
+                self.monitor.sample(counters=self.metrics.snapshot())
+            if self.exporter is not None:
+                # idle ticks keep the progress fingerprint unchanged —
+                # only movement appends to the durable stream (the ring
+                # buffer above is bounded, the file is not)
+                self._export_snapshot()
         finished, self._finished_tick = self._finished_tick, []
         return finished
 
@@ -684,6 +744,11 @@ class InferenceEngine:
                 max_steps, self.pending,
             )
             self._abort_pending(f"run(max_steps={max_steps}) exhausted")
+        if self.exporter is not None:
+            # final snapshot: a short-lived run must leave its terminal
+            # counters on the durable stream even between cadence points
+            # (deduped — ending exactly on a cadence step appends once)
+            self._export_snapshot()
         return dict(self._results)
 
     def drain(
@@ -713,6 +778,8 @@ class InferenceEngine:
             steps += 1
         if self.pending:
             self._abort_pending(f"drain(max_steps={max_steps}) exhausted")
+        if self.exporter is not None:
+            self._export_snapshot()
         return dict(self._results)
 
     def result(self, request_id: int) -> Optional[RequestResult]:

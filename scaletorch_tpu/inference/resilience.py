@@ -243,6 +243,7 @@ def make_serving_watchdog(
 
     def _report(info: dict) -> None:
         monitor = getattr(engine, "monitor", None)
+        tracer = getattr(engine, "tracer", None)
         write_crash_report(
             info.get("reason", "serving stall watchdog fired"),
             engine.metrics.decode_steps,
@@ -252,6 +253,10 @@ def make_serving_watchdog(
                 list(monitor.records) if monitor is not None else None
             ),
             thread_stacks=info.get("thread_stacks"),
+            # the engine's span timeline (tick/admission/prefill/decode)
+            # right up to the stall — same enriched layout as training
+            # crash reports (docs/fault_tolerance.md)
+            span_tail=(tracer.tail() if tracer is not None else None),
             extra={
                 "serving": True,
                 "exit_code": SERVING_STALL_EXIT_CODE,

@@ -327,6 +327,8 @@ class FaultInjector:
     hang_at_step: int = 0
     hang_seconds: float = 120.0
     bad_batch_at_step: int = 0
+    slow_step_at_step: int = 0
+    slow_step_seconds: float = 0.5
     # host identity for the one-host drills; None = resolve from the JAX
     # runtime lazily (fake-host tests set it explicitly)
     host_index: Optional[int] = None
@@ -338,6 +340,7 @@ class FaultInjector:
     _nan_fired: bool = field(default=False, repr=False)
     _sigterm_fired: bool = field(default=False, repr=False)
     _hang_fired: bool = field(default=False, repr=False)
+    _slow_fired: bool = field(default=False, repr=False)
 
     @classmethod
     def from_config(cls, cfg) -> "FaultInjector":
@@ -363,13 +366,18 @@ class FaultInjector:
             hang_seconds=float(getattr(cfg, "ft_hang_seconds", 120.0)),
             bad_batch_at_step=env_or("SCALETORCH_TPU_FT_BAD_BATCH_STEP",
                                      "ft_bad_batch_at_step"),
+            slow_step_at_step=env_or("SCALETORCH_TPU_FT_SLOW_STEP_STEP",
+                                     "ft_slow_step_at_step"),
+            slow_step_seconds=float(env_override(
+                "SCALETORCH_TPU_FT_SLOW_STEP_SECONDS",
+                getattr(cfg, "ft_slow_step_seconds", 0.5))),
         )
 
     @property
     def active(self) -> bool:
         return bool(self.nan_at_step or self.fail_saves
                     or self.sigterm_at_step or self.hang_at_step
-                    or self.bad_batch_at_step)
+                    or self.bad_batch_at_step or self.slow_step_at_step)
 
     def _host(self) -> int:
         if self.host_index is not None:
@@ -420,6 +428,21 @@ class FaultInjector:
                 f"after step {step}"
             )
             time.sleep(self.hang_seconds)
+
+    def maybe_slow_step(self, step: int) -> None:
+        """Telemetry drill: stall step ``step`` at its boundary once, so
+        its wall time spikes and the slow-step detector
+        (telemetry/profiling.py) arms a bounded profiler window. A
+        pure delay — unlike ``maybe_hang`` it is sized to stay well
+        under any watchdog timeout."""
+        if self.slow_step_at_step and step == self.slow_step_at_step \
+                and not self._slow_fired:
+            self._slow_fired = True
+            get_logger().warning(
+                f"fault injection: slowing step {step} by "
+                f"{self.slow_step_seconds:g}s"
+            )
+            time.sleep(self.slow_step_seconds)
 
     def take_bad_read(self, position: int) -> bool:
         """True when the batch read at absolute stream ``position`` must

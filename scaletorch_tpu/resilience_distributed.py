@@ -177,6 +177,10 @@ class CoordinatedResilience:
         # stop flag agreed by the LAST after_step decision (same gather —
         # the boundary poll reuses it instead of a second collective)
         self._stop_agreed: Optional[bool] = None
+        # optional telemetry.StragglerDetector: per-host step/data-fetch
+        # times ride the SAME observation gather (zero new collectives)
+        # and host 0 reduces them into the fleet summary + counters
+        self.straggler = None
 
     @classmethod
     def from_config(cls, cfg, manager: ResilienceManager
@@ -240,13 +244,18 @@ class CoordinatedResilience:
         *,
         rollback: Optional[Callable[[], bool]] = None,
         position: Optional[int] = None,
+        telemetry: Optional[Dict[str, Any]] = None,
     ) -> tuple:
         """Coordinated replacement for ``ResilienceManager.after_step``;
         same ``(metrics, action)`` contract. ``position`` is this host's
         absolute data-stream position: a host-local skip of an unreadable
         region (data/dataloader.py) silently desyncs the stream — every
         later gradient averages mismatched batches — so positions ride
-        the same gather and any disagreement aborts the fleet loudly."""
+        the same gather and any disagreement aborts the fleet loudly.
+        ``telemetry`` is this host's per-step timing observation
+        (``{step_time, data_fetch_time}``): it rides the same gather and
+        feeds host 0's ``StragglerDetector`` — the straggler layer costs
+        zero collectives of its own."""
         mgr = self.manager
         if not self.coordinated:
             return mgr.after_step(step, metrics, rollback=rollback)
@@ -269,11 +278,15 @@ class CoordinatedResilience:
             "forced": forced,
             "stop": mgr.stop_requested,
             "position": position,
+            "telemetry": telemetry,
         }
         observations = self.bus.all_gather(local)
         decision = None
         if self.bus.is_main:
             decision = self._form_decision(step, observations)
+            if self.straggler is not None:
+                self.straggler.observe(
+                    step, [o.get("telemetry") for o in observations])
         decision = self.bus.broadcast_from_main(decision)
         # cache the agreed stop flag for the boundary poll (one
         # collective round per step; abort below makes it moot)
@@ -374,6 +387,15 @@ class CoordinatedResilience:
 
     def counters(self) -> Dict[str, float]:
         return self.manager.counters()
+
+    def straggler_counters(self) -> Dict[str, float]:
+        """Straggler counters for the metrics extras ({} when the
+        detector is not attached — single-process runs have no fleet to
+        compare). Non-zero only on host 0, the host whose console line
+        and ring buffer a multi-host run reads anyway."""
+        if self.straggler is None:
+            return {}
+        return self.straggler.counters()
 
 
 # --------------------------------------------------------------------------
@@ -522,12 +544,16 @@ def write_crash_report(
     last_metrics: Optional[List[dict]] = None,
     counters: Optional[Dict[str, float]] = None,
     thread_stacks: Optional[Dict[str, str]] = None,
+    span_tail: Optional[List[dict]] = None,
     process_index: int = 0,
     extra: Optional[Dict[str, Any]] = None,
 ) -> str:
     """Persist a JSON post-mortem; returns the path. Never raises to the
     caller's caller — an abort path must abort, not crash inside its own
-    diagnostics (I/O errors are logged and an empty path returned)."""
+    diagnostics (I/O errors are logged and an empty path returned).
+    ``span_tail`` is the telemetry tracer's newest span events — the
+    host-side timeline right up to the fault, next to the monitor ring
+    buffer (docs/fault_tolerance.md, enriched report layout)."""
     suffix = f"_proc{process_index}" if process_index else ""
     path = os.path.join(
         directory, f"crash_report_step{step if step is not None else 'NA'}"
@@ -544,6 +570,7 @@ def write_crash_report(
         "counters": counters or {},
         "last_metrics": last_metrics or [],
         "monitor_records": monitor_records or [],
+        "span_timeline_tail": span_tail or [],
         "thread_stacks": thread_stacks or {},
         **(extra or {}),
     }

@@ -1,0 +1,192 @@
+"""Machine-readable telemetry export: JSONL event stream + Prometheus.
+
+The consumers the ROADMAP names — a load-aware serving scheduler, fleet
+log aggregation, the future front door's admission control — all need
+metrics they can *parse*, not console lines. Two surfaces:
+
+  * ``TelemetryExporter`` — an append-only JSONL stream (one event per
+    line) merging the trainer's ``MetricsLogger`` step records and the
+    engine's ``EngineMetrics`` snapshots into ONE schema-versioned
+    format. Each line carries ``v`` (schema version), ``kind``
+    (``train_step`` / ``engine_metrics`` / free-form), ``time`` and
+    ``proc``; the rest is the flat numeric record. Version policy:
+    additive field changes keep ``v``; renames/removals/semantic
+    changes bump it (docs/observability.md).
+  * ``PrometheusEndpoint`` — an optional stdlib-only HTTP endpoint
+    serving the text exposition format from a caller-supplied
+    ``metrics_fn`` (e.g. ``engine.metrics.snapshot``), so live
+    occupancy/TTFT is scrapeable without adding dependencies. Bind
+    port 0 for an ephemeral port (tests); the serving front door reads
+    ``endpoint.port`` after ``start()``.
+
+Both are pure host-side I/O — nothing here touches a device value.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import IO, Any, Callable, Dict, Optional
+
+from scaletorch_tpu.utils.logger import get_logger
+
+# Bump on renames/removals/semantic changes; additive fields keep it.
+SCHEMA_VERSION = 1
+
+
+class TelemetryExporter:
+    """Append-only JSONL event stream (one line per event, flushed per
+    line so a crash loses at most the in-flight event)."""
+
+    def __init__(self, path: str, *, process_index: int = 0) -> None:
+        self.path = path
+        self.process_index = process_index
+        self.events_written = 0
+        self._lock = threading.Lock()
+        self._file: Optional[IO[str]] = None
+        self._closed = False
+
+    def emit(self, kind: str, record: Dict[str, Any]) -> None:
+        """Write one event line. ``record`` must be JSON-serialisable
+        (flat numeric dicts from MetricsLogger / EngineMetrics are);
+        non-serialisable values are repr'd rather than dropped."""
+        line = json.dumps(
+            {
+                "v": SCHEMA_VERSION,
+                "kind": kind,
+                "time": time.time(),
+                "proc": self.process_index,
+                **record,
+            },
+            default=repr,
+        )
+        with self._lock:
+            if self._closed:
+                return
+            if self._file is None:
+                os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+                self._file = open(self.path, "a")
+            self._file.write(line + "\n")
+            self._file.flush()
+            self.events_written += 1
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def read_jsonl(path: str) -> list:
+    """Read an exported stream back (tests / offline analysis)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+_METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def render_prometheus(metrics: Dict[str, float],
+                      *, namespace: str = "scaletorch") -> str:
+    """Flat numeric dict -> Prometheus text exposition format (0.0.4).
+    Non-numeric values are skipped; names are sanitised to the metric
+    charset and prefixed with ``namespace_``."""
+    lines = []
+    for key in sorted(metrics):
+        value = metrics[key]
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            continue
+        name = f"{namespace}_{_METRIC_NAME_RE.sub('_', str(key))}"
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {float(value)}")
+    return "\n".join(lines) + "\n"
+
+
+class PrometheusEndpoint:
+    """Minimal ``/metrics`` HTTP endpoint over a metrics callback.
+
+    ``metrics_fn`` is called per scrape on the server thread — it must
+    be cheap and sync-free (``EngineMetrics.snapshot`` and
+    ``MetricsLogger.history[-1]`` both qualify). Scrape errors return
+    500 and never propagate into the serving loop."""
+
+    def __init__(
+        self,
+        metrics_fn: Callable[[], Dict[str, float]],
+        *,
+        port: int = 0,
+        host: str = "127.0.0.1",
+        namespace: str = "scaletorch",
+    ) -> None:
+        self.metrics_fn = metrics_fn
+        self.namespace = namespace
+        self._host = host
+        self._requested_port = port
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.port: Optional[int] = None
+
+    def start(self) -> "PrometheusEndpoint":
+        endpoint = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self) -> None:  # noqa: N802 (http.server contract)
+                if self.path.rstrip("/") not in ("", "/metrics"):
+                    self.send_error(404)
+                    return
+                try:
+                    body = render_prometheus(
+                        endpoint.metrics_fn(), namespace=endpoint.namespace
+                    ).encode()
+                except Exception as exc:  # scrape must not kill serving
+                    self.send_error(500, repr(exc))
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args) -> None:  # quiet scrapes
+                return
+
+        self._server = ThreadingHTTPServer(
+            (self._host, self._requested_port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="scaletorch-prometheus", daemon=True,
+        )
+        self._thread.start()
+        get_logger().info(
+            f"prometheus endpoint serving on "
+            f"http://{self._host}:{self.port}/metrics"
+        )
+        return self
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self) -> "PrometheusEndpoint":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

@@ -1,0 +1,319 @@
+"""Anomaly-triggered device profiling + live snapshots.
+
+Host-side spans (telemetry/spans.py) show where the *host* spent time;
+when a step is anomalously slow the question is what the *device* was
+doing — and by the time an operator attaches a profiler by hand, the
+anomaly is gone. This module closes that gap three ways:
+
+  * ``SlowStepDetector`` — a step-wall-time EMA + spike factor (the
+    same detector shape as the loss DivergenceSentinel): a step slower
+    than ``spike_factor`` x its EMA is an anomaly. Anomalous times
+    never feed the EMA, so one stall doesn't inflate the baseline.
+  * ``AnomalyProfiler`` — arms a BOUNDED ``jax.profiler.trace()``
+    window over the next ``window_steps`` steps when the detector
+    fires (at most ``max_captures`` windows per run, so a persistently
+    sick run cannot fill the disk with profiles), and supports a
+    manual ``--profile_steps start:stop`` window for planned captures.
+    Captures land under ``<telemetry_dir>/profiles/``.
+  * ``LiveSnapshotter`` — a SIGUSR1 handler that dumps a live snapshot
+    (span tail + monitor ring buffer + all thread stacks) to a JSON
+    file WITHOUT stopping the run: the "what is it doing right now?"
+    tool for a wedged-looking job that hasn't tripped the watchdog.
+
+The profiler backend is injectable (tests use a recording fake); the
+default is ``jax.profiler``, imported lazily so this module stays
+importable in jax-free tooling contexts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from scaletorch_tpu.utils.logger import get_logger
+
+
+def parse_profile_steps(spec: str) -> Optional[Tuple[int, int]]:
+    """``"start:stop"`` -> (start, stop) with 1 <= start < stop; "" ->
+    None. The window is [start, stop): profiling starts when step
+    ``start`` begins and stops when step ``stop`` begins."""
+    if not spec:
+        return None
+    parts = spec.split(":")
+    try:
+        if len(parts) != 2:
+            raise ValueError
+        start, stop = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"profile_steps must be 'start:stop' (integers), got {spec!r}"
+        ) from None
+    if start < 1 or stop <= start:
+        raise ValueError(
+            f"profile_steps needs 1 <= start < stop, got {spec!r}"
+        )
+    return start, stop
+
+
+class SlowStepDetector:
+    """Step-time EMA + spike factor (the DivergenceSentinel shape,
+    pointed at wall time instead of loss)."""
+
+    def __init__(self, spike_factor: float, *, ema_beta: float = 0.9,
+                 warmup_steps: int = 1) -> None:
+        if spike_factor <= 1.0:
+            raise ValueError(
+                f"spike_factor must be > 1, got {spike_factor}"
+            )
+        if not 0.0 <= ema_beta < 1.0:
+            raise ValueError(f"ema_beta must be in [0, 1), got {ema_beta}")
+        self.spike_factor = spike_factor
+        self.ema_beta = ema_beta
+        self.warmup_steps = warmup_steps
+        self.ema: Optional[float] = None
+        self.observed = 0
+        self.spikes = 0
+
+    def observe(self, step_time: float) -> bool:
+        """Feed one step's wall time; True when it spiked. The first
+        ``warmup_steps`` observations are DISCARDED entirely — a cold
+        JIT-compile first step is orders of magnitude over steady state
+        and would poison the baseline if it seeded the EMA; the next
+        observation seeds it. Anomalous times never feed the EMA."""
+        self.observed += 1
+        if self.observed <= self.warmup_steps:
+            return False
+        if self.ema is None:
+            self.ema = step_time
+            return False
+        if step_time > self.spike_factor * self.ema:
+            self.spikes += 1
+            return True
+        self.ema = self.ema_beta * self.ema + (1 - self.ema_beta) * step_time
+        return False
+
+
+class _JaxProfilerBackend:
+    """Thin start/stop adapter over ``jax.profiler`` (lazy import)."""
+
+    def start(self, log_dir: str) -> None:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+
+    def stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+
+
+class AnomalyProfiler:
+    """Bounded ``jax.profiler`` capture windows, armed by slow steps or
+    a manual step range.
+
+    Call ``before_step(step)`` at the loop boundary (the step about to
+    run) and ``after_step(step, step_time)`` once it finishes; both are
+    single-branch no-ops while nothing is armed. A detector fire arms a
+    window over the next ``window_steps`` steps; the manual window
+    ``profile_steps=(start, stop)`` covers [start, stop). Windows never
+    overlap and anomaly captures are capped at ``max_captures``.
+    ``captures`` records every window (trigger, steps, directory) for
+    logs and tests.
+    """
+
+    def __init__(
+        self,
+        telemetry_dir: str,
+        *,
+        window_steps: int = 3,
+        spike_factor: float = 0.0,
+        max_captures: int = 1,
+        profile_steps: Optional[Tuple[int, int]] = None,
+        backend: Optional[Any] = None,
+    ) -> None:
+        if window_steps < 1:
+            raise ValueError(f"window_steps must be >= 1, got {window_steps}")
+        self.telemetry_dir = telemetry_dir
+        self.window_steps = window_steps
+        self.max_captures = max_captures
+        self.profile_steps = profile_steps
+        self.detector = (
+            SlowStepDetector(spike_factor) if spike_factor else None
+        )
+        self._backend = backend if backend is not None else _JaxProfilerBackend()
+        self.captures: List[Dict[str, Any]] = []
+        self._active: Optional[Dict[str, Any]] = None
+        self._anomaly_captures = 0
+        self._manual_done = False
+        self._broken = False
+
+    @property
+    def active(self) -> bool:
+        return self._active is not None
+
+    def before_step(self, step: int) -> None:
+        """Boundary hook, called with the step about to run: opens the
+        manual window at its start step and closes any window whose
+        stop step arrived."""
+        if self._active is not None and step >= self._active["stop_step"]:
+            self._stop()
+        if (self.profile_steps is not None and not self._manual_done
+                and self._active is None):
+            start, stop = self.profile_steps
+            # >= not ==: a resumed run whose global step already passed
+            # `start` still captures the remainder of the window (and a
+            # window entirely in the past warns instead of silently
+            # never firing).
+            if step >= stop:
+                self._manual_done = True
+                get_logger().warning(
+                    f"--profile_steps {start}:{stop} window is already "
+                    f"past at step {step} (resumed run?): no manual "
+                    f"capture will be taken"
+                )
+            elif step >= start:
+                self._manual_done = True
+                self._start("manual", step, stop)
+
+    def after_step(self, step: int, step_time: float) -> None:
+        """Per-step hook: feeds the slow-step detector and arms an
+        anomaly window over the next ``window_steps`` steps when it
+        fires (bounded by ``max_captures``)."""
+        if self._active is not None:
+            if step + 1 >= self._active["stop_step"]:
+                self._stop()
+            return
+        if self.detector is None:
+            return
+        spiked = self.detector.observe(step_time)
+        if (spiked and self._anomaly_captures < self.max_captures
+                and not self._broken):
+            self._anomaly_captures += 1
+            get_logger().warning(
+                f"slow step detected at step {step} "
+                f"({step_time:.3f}s > {self.detector.spike_factor:g}x EMA "
+                f"{self.detector.ema:.3f}s): profiling the next "
+                f"{self.window_steps} steps"
+            )
+            self._start("slow_step", step + 1, step + 1 + self.window_steps)
+
+    def close(self) -> None:
+        """Stop an in-flight window (run ended mid-capture)."""
+        if self._active is not None:
+            self._stop()
+
+    # ---- window mechanics ------------------------------------------------
+    def _start(self, trigger: str, start_step: int, stop_step: int) -> None:
+        log_dir = os.path.join(
+            self.telemetry_dir, "profiles", f"{trigger}_step{start_step}"
+        )
+        try:
+            os.makedirs(log_dir, exist_ok=True)
+            self._backend.start(log_dir)
+        except Exception as exc:
+            # profiling is diagnostics, never a crash reason — degrade
+            # and stop re-arming (a broken backend would fail every time)
+            self._broken = True
+            get_logger().warning(f"profiler capture unavailable: {exc!r}")
+            return
+        self._active = {
+            "trigger": trigger, "start_step": start_step,
+            "stop_step": stop_step, "dir": log_dir,
+        }
+
+    def _stop(self) -> None:
+        window = self._active
+        self._active = None
+        try:
+            self._backend.stop()
+        except Exception as exc:
+            self._broken = True
+            get_logger().warning(f"profiler stop failed: {exc!r}")
+            return
+        self.captures.append(window)
+        get_logger().info(
+            f"profiler window captured: steps "
+            f"[{window['start_step']}, {window['stop_step']}) "
+            f"({window['trigger']}) -> {window['dir']}"
+        )
+
+
+class LiveSnapshotter:
+    """SIGUSR1 -> dump a live post-mortem WITHOUT stopping the run.
+
+    The handler runs in the main thread between bytecodes (CPython
+    signal semantics), writes
+    ``<telemetry_dir>/live_snapshot_<n>.json`` — the ``snapshot_fn``
+    payload (span tail, monitor ring buffer, counters, current step)
+    plus every thread's stack — and returns. Install/uninstall are
+    no-ops off the main thread or where SIGUSR1 does not exist, so
+    tests and notebook embeddings never crash on it."""
+
+    def __init__(self, telemetry_dir: str,
+                 snapshot_fn: Optional[Callable[[], dict]] = None) -> None:
+        self.telemetry_dir = telemetry_dir
+        self.snapshot_fn = snapshot_fn
+        self.snapshots_written = 0
+        self._prev_handler: Any = None
+        self._installed = False
+
+    def install(self, snapshot_fn: Optional[Callable[[], dict]] = None) -> bool:
+        if snapshot_fn is not None:
+            self.snapshot_fn = snapshot_fn
+        if self._installed:
+            return True
+        signum = getattr(signal, "SIGUSR1", None)
+        if signum is None:
+            return False
+        try:
+            self._prev_handler = signal.signal(signum, self._handle)
+        except ValueError:
+            # not the main thread (e.g. a worker harness): no handler
+            return False
+        self._installed = True
+        return True
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        try:
+            signal.signal(signal.SIGUSR1, self._prev_handler or signal.SIG_DFL)
+        except ValueError:
+            pass
+        self._installed = False
+
+    def _handle(self, signum, frame) -> None:
+        # local import keeps module load light; dump_thread_stacks is
+        # pure-Python introspection, safe in a handler context
+        from scaletorch_tpu.resilience_distributed import dump_thread_stacks
+
+        payload: Dict[str, Any] = {"time": time.time()}
+        try:
+            if self.snapshot_fn is not None:
+                payload.update(self.snapshot_fn())
+        except Exception as exc:  # a snapshot must never kill the run
+            payload["snapshot_error"] = repr(exc)
+        payload["thread_stacks"] = dump_thread_stacks()
+        self.snapshots_written += 1
+        path = os.path.join(
+            self.telemetry_dir, f"live_snapshot_{self.snapshots_written}.json"
+        )
+        try:
+            os.makedirs(self.telemetry_dir, exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1, default=repr)
+        except OSError as exc:
+            get_logger().error(f"live snapshot failed: {exc!r}")
+            return
+        get_logger().info(f"live snapshot written to {path}")
+
+    # context-manager sugar for tests / serving loops
+    def __enter__(self) -> "LiveSnapshotter":
+        self.install()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -26,12 +26,12 @@ from scaletorch_tpu.utils.logger import get_logger
 from scaletorch_tpu.utils.misc import get_mfu, to_readable_format
 
 # Cumulative resilience counters (DivergenceSentinel.counters / the
-# in-step update_skipped flag) recognised in ``extras`` — forwarded into
-# the SystemMonitor ring buffer and surfaced on the console line when
-# nonzero.
+# in-step update_skipped flag / the straggler detector) recognised in
+# ``extras`` — forwarded into the SystemMonitor ring buffer and surfaced
+# on the console line when nonzero.
 ANOMALY_COUNTER_KEYS = (
     "anomalies", "nonfinite_losses", "loss_spikes", "rollbacks",
-    "update_skipped",
+    "update_skipped", "straggler_flags",
 )
 
 
@@ -47,6 +47,10 @@ class MetricsLogger:
     log_frequency: int = 1
     peak_flops: Optional[float] = None
     collect_system: bool = True   # host CPU/mem + accel env per logged step
+    # optional telemetry.TelemetryExporter: every logged record also
+    # lands on the JSONL event stream (kind 'train_step') — the durable,
+    # machine-readable twin of the console line
+    exporter: Optional[object] = None
     history: list = field(default_factory=list)
     _window_start_time: Optional[float] = None
     _window_start_step: Optional[int] = None
@@ -137,6 +141,8 @@ class MetricsLogger:
                              "device_peak_mem_gb")
             )
         self.history.append(record)
+        if self.exporter is not None:
+            self.exporter.emit("train_step", record)
 
         if jax.process_index() == 0:
             parts = [
@@ -159,9 +165,15 @@ class MetricsLogger:
                 parts.append("UPDATE-SKIPPED")
             if record.get("anomalies"):
                 parts.append(f"anomalies {int(record['anomalies'])}")
+            if record.get("straggler_flags"):
+                parts.append(
+                    f"STRAGGLER host {int(record.get('straggler_host', -1))}")
             if "memory_gb" in record:
                 parts.append(f"mem {record['memory_gb']:.1f}GB")
-            get_logger().info(" | ".join(parts))
+            # the structured twin of the human line: --log_format json
+            # (utils/logger.JsonFormatter) emits the record dict as-is
+            get_logger().info(" | ".join(parts),
+                              extra={"structured_record": record})
         return record
 
     def ring_buffer(self, last_n: Optional[int] = None) -> list:

@@ -21,6 +21,7 @@ from scaletorch_tpu.config import ScaleTorchTPUArguments
 from scaletorch_tpu.models import llama, qwen3
 from scaletorch_tpu.models.registry import resolve_attention_backend
 from scaletorch_tpu.parallel.mesh import MeshManager, setup_mesh_manager
+from scaletorch_tpu.telemetry.spans import NOOP_SPAN
 from scaletorch_tpu.trainer.metrics import MetricsLogger
 from scaletorch_tpu.trainer.optimizer import create_optimizer
 from scaletorch_tpu.utils.logger import get_logger
@@ -180,7 +181,8 @@ class Trainer:
 
     def __init__(self, cfg: ScaleTorchTPUArguments):
         self.cfg = cfg
-        self.logger = get_logger(log_file=cfg.log_file)
+        self.logger = get_logger(log_file=cfg.log_file,
+                                 log_format=cfg.log_format)
         if cfg.verbose:
             import logging
 
@@ -524,6 +526,37 @@ class Trainer:
             num_chips=len(jax.devices()),
             log_frequency=cfg.log_frequency,
         )
+        # Unified telemetry (scaletorch_tpu/telemetry/): span tracing,
+        # JSONL export, anomaly-triggered profiling, SIGUSR1 snapshots —
+        # all off (every component None, one branch per site) unless
+        # --telemetry_dir / SCALETORCH_TPU_TELEMETRY_DIR is set. The
+        # straggler detector is independent of the directory: it rides
+        # the coordinator's existing per-step gather (zero collectives
+        # of its own) whenever the run is multi-host coordinated.
+        from scaletorch_tpu.telemetry import StragglerDetector, Telemetry
+
+        self.telemetry = Telemetry.from_config(
+            cfg, process_index=jax.process_index())
+        self._tracer = self.telemetry.tracer
+        self.metrics.exporter = self.telemetry.exporter
+        self._last_data_fetch_s = 0.0
+        if self.telemetry.snapshotter is not None:
+            # install the SIGUSR1 handler NOW, not at train(): the
+            # startup log invites the operator to poke the pid, and an
+            # unhandled SIGUSR1 during the setup/compile window would
+            # kill the run (default disposition is terminate). Uninstall
+            # happens in close() via telemetry.close().
+            self.telemetry.snapshotter.install(self._live_snapshot)
+        if cfg.straggler_factor and self.coordinator.coordinated:
+            # multi-host only: a single process has no fleet to compare,
+            # and an unattached detector keeps straggler_counters() == {}
+            # so solo runs' records carry no vestigial straggler fields
+            self.coordinator.straggler = StragglerDetector(
+                factor=cfg.straggler_factor,
+                patience=cfg.straggler_patience,
+                log_frequency=cfg.log_frequency,
+                tracer=self._tracer,
+            )
         self.logger.info(
             f"model={cfg.model_type} params={to_readable_format(n_params)} "
             f"mesh={self.mm} backend={self.attention_backend} "
@@ -685,11 +718,16 @@ class Trainer:
         Metrics logging, eval and checkpoint cadence stay in ``train`` —
         this method is just the step.
         """
+        self._last_data_fetch_s = 0.0
         if batch is None:
             if self._train_iter is None:
                 self._train_iter = iter(self.loader)
             self._beat("data_fetch")
+            t_fetch = time.perf_counter()
             batch = next(self._train_iter)
+            # host-side fetch time: rides the coordination gather so the
+            # straggler detector can tell input starvation from compute
+            self._last_data_fetch_s = time.perf_counter() - t_fetch
         dev_batch = self._device_batch(batch)
         self._beat("step_dispatch")
         self.params, self.opt_state, m = self.step_fn(
@@ -756,14 +794,30 @@ class Trainer:
                 crash_report=self._watchdog_crash_report,
                 exit_fn=self._watchdog_exit,
             ).start()
+        if self.telemetry.snapshotter is not None:
+            # SIGUSR1 -> live snapshot (span tail + ring buffer + thread
+            # stacks) without stopping the run. Normally armed since
+            # __init__; idempotent re-install covers harnesses that bind
+            # train() onto a foreign trainer object.
+            self.telemetry.snapshotter.install(self._live_snapshot)
+        profiler = self.telemetry.profiler
         try:
             while self.global_step < target_step:
                 self._beat("step_boundary")
+                t_boundary = time.perf_counter()
+                # telemetry drill: an injected stall here inflates the
+                # ABOUT-TO-RUN step's wall time (global_step + 1 = the
+                # step this iteration performs) so the slow-step
+                # detector fires on exactly the configured step
+                self.resilience.injector.maybe_slow_step(self.global_step + 1)
+                if profiler is not None:
+                    profiler.before_step(self.global_step + 1)
                 if self.coordinator.should_stop():
                     self._emergency_checkpoint()
                     self.preempted = True
                     break
                 m = self.step()
+                step_time = time.perf_counter() - t_boundary
                 anomaly_step = self.global_step
                 m, action = self.coordinator.after_step(
                     anomaly_step, m,
@@ -772,7 +826,13 @@ class Trainer:
                     # skip of an unreadable region must abort loudly,
                     # not silently train on mismatched batches
                     position=self._stream_position(),
+                    # per-host timings ride the SAME gather — the
+                    # straggler layer adds zero collectives
+                    telemetry={"step_time": step_time,
+                               "data_fetch_time": self._last_data_fetch_s},
                 )
+                if profiler is not None:
+                    profiler.after_step(anomaly_step, step_time)
                 if action == "rollback":
                     # global_step has moved back to the restored
                     # checkpoint; the anomalous step's metrics would be
@@ -789,6 +849,7 @@ class Trainer:
                         **{k: v for k, v in m.items()
                            if k not in ("loss", "grad_norm")},
                         **self.resilience.counters(),
+                        **self.coordinator.straggler_counters(),
                     },
                 )
                 if (
@@ -825,6 +886,15 @@ class Trainer:
                 self._watchdog.stop()
                 self._watchdog = None
             self.resilience.uninstall_preemption_handler()
+            if profiler is not None:
+                profiler.close()  # stop an in-flight capture window
+            # the SIGUSR1 handler stays armed between train() calls —
+            # a poke while idle must dump, not kill; close() uninstalls
+            if self._tracer is not None:
+                # train() may be called again (benchmark contract): end
+                # the open phase and flush, but keep the tracer live
+                self._tracer.end_phase()
+            self.telemetry.flush()
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()  # drain any in-flight async save
         if self.cfg.performance_log_dir:
@@ -841,12 +911,14 @@ class Trainer:
         return last
 
     def close(self) -> None:
-        """Release external resources (wandb run, async checkpoint pool)."""
+        """Release external resources (wandb run, async checkpoint pool,
+        telemetry artifacts — the trace file becomes valid JSON here)."""
         if self._wandb is not None:
             self._wandb.finish()
             self._wandb = None
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()
+        self.telemetry.close()
 
     def _layer_storage(self) -> str:
         """Identity of the stacked-layer STORAGE order this run trains in.
@@ -862,9 +934,22 @@ class Trainer:
         return "model_order"
 
     def _beat(self, phase: str) -> None:
-        """Feed the hang watchdog (no-op when it is not armed)."""
+        """Feed the hang watchdog AND the span tracer's phase track —
+        liveness and tracing share one phase vocabulary (step_boundary /
+        data_fetch / step_dispatch / checkpoint / emergency_checkpoint),
+        so a watchdog crash report and a Perfetto timeline name the same
+        sites. No-op (one branch each) when neither is armed."""
         if self._watchdog is not None:
             self._watchdog.beat(self.global_step, phase)
+        if self._tracer is not None:
+            self._tracer.phase(phase, step=self.global_step)
+
+    def _span(self, name: str, **args):
+        """Telemetry span when a tracer is attached, shared no-op
+        otherwise (one branch — the telemetry/spans.py contract)."""
+        if self._tracer is None:
+            return NOOP_SPAN
+        return self._tracer.span(name, **args)
 
     def _agree_all(self, flag: bool) -> bool:
         """True iff every host holds True (identity single-process). Any
@@ -903,10 +988,24 @@ class Trainer:
             last_metrics=self.metrics.history[-5:],
             counters=self.resilience.counters(),
             thread_stacks=thread_stacks,
+            span_tail=self.telemetry.span_tail(),
             process_index=(self.coordinator.bus.process_index
                            if self.coordinator.coordinated
                            else jax.process_index()),
         )
+
+    def _live_snapshot(self) -> Dict[str, Any]:
+        """SIGUSR1 payload (telemetry.LiveSnapshotter): the same
+        diagnostics a crash report carries, taken from a LIVE run."""
+        return {
+            "step": self.global_step,
+            "tokens_seen": self.tokens_seen,
+            "span_tail": self.telemetry.span_tail(),
+            "monitor_records": self.metrics.ring_buffer(64),
+            "last_metrics": self.metrics.history[-5:],
+            "counters": {**self.resilience.counters(),
+                         **self.coordinator.straggler_counters()},
+        }
 
     def _watchdog_crash_report(self, info: dict) -> str:
         """HangWatchdog callback: persist the post-mortem (thread stacks
@@ -923,14 +1022,15 @@ class Trainer:
     def save_checkpoint(self) -> bool:
         self._beat("checkpoint")
         position = self._stream_position()
-        saved = self.checkpoint_manager.save(
-            step=self.global_step,
-            params=self.params,
-            opt_state=self.opt_state,
-            extra={"tokens_seen": self.tokens_seen,
-                   "loader_position": position,
-                   "layer_storage": self._layer_storage()},
-        )
+        with self._span("checkpoint_save", step=self.global_step):
+            saved = self.checkpoint_manager.save(
+                step=self.global_step,
+                params=self.params,
+                opt_state=self.opt_state,
+                extra={"tokens_seen": self.tokens_seen,
+                       "loader_position": position,
+                       "layer_storage": self._layer_storage()},
+            )
         if saved:
             self._saved_loader_position = position
         return saved

@@ -4,10 +4,18 @@ Counterpart of reference scaletorch/utils/logger_utils.py:18-140: a colored
 formatter carrying the process index, with the main process logging at INFO
 to stdout (+ optional file) and every other host ERROR-only, so multi-host
 launches don't interleave N copies of every line.
+
+``log_format='json'`` swaps every handler to ``JsonFormatter``: one JSON
+object per line, so fleet log aggregation parses fields instead of the
+``" | "``-joined human lines. Metrics step records pass through as-is
+(``MetricsLogger`` attaches the flat record dict via
+``extra={"structured_record": ...}``); plain messages are wrapped as
+``{"msg": ...}``. Both shapes carry ``ts`` / ``level`` / ``proc``.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import sys
@@ -77,20 +85,83 @@ class ColorfulFormatter(logging.Formatter):
         return f"{prefix} {record.getMessage()}"
 
 
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line. A metrics step record attached as
+    ``extra={"structured_record": {...}}`` is emitted AS-IS (plus the
+    ts/level/proc envelope); any other message is wrapped as ``msg``."""
+
+    def __init__(self, process_index: int) -> None:
+        super().__init__()
+        self.process_index = process_index
+
+    def format(self, record: logging.LogRecord) -> str:
+        base = {
+            "ts": self.formatTime(record, "%Y-%m-%dT%H:%M:%S"),
+            "level": record.levelname,
+            "proc": self.process_index,
+        }
+        structured = getattr(record, "structured_record", None)
+        if isinstance(structured, dict):
+            return json.dumps({**base, **structured}, default=repr)
+        return json.dumps({**base, "msg": record.getMessage()}, default=repr)
+
+
+def _make_formatter(log_format: str, process_index: int,
+                    use_color: bool) -> logging.Formatter:
+    if log_format == "json":
+        return JsonFormatter(process_index)
+    return ColorfulFormatter(process_index, use_color)
+
+
+# The process-wide format. An explicit ``log_format`` flips it for EVERY
+# scaletorch logger — the ones library modules already created with
+# ``get_logger(__name__)`` at import time AND the ones created later —
+# because a fleet log aggregator parses the whole stream, not one
+# logger's slice of it.
+_DEFAULT_FORMAT = "text"
+
+
+def _swap_handler_formats(logger: logging.Logger, fmt: str,
+                          process_index: int) -> None:
+    for h in logger.handlers:
+        use_color = (
+            isinstance(h, logging.StreamHandler)
+            and not isinstance(h, logging.FileHandler)
+            and sys.stdout.isatty()
+            and os.environ.get("NO_COLOR") is None
+        )
+        h.setFormatter(_make_formatter(fmt, process_index, use_color))
+
+
 def get_logger(
     name: str = "scaletorch_tpu",
     log_file: Optional[str] = None,
     level: int = logging.INFO,
+    log_format: Optional[str] = None,
 ) -> logging.Logger:
+    global _DEFAULT_FORMAT
+    if log_format is not None and log_format != _DEFAULT_FORMAT:
+        # format is process-global: adopt it for future loggers and
+        # reformat every scaletorch logger configured so far
+        _DEFAULT_FORMAT = log_format
+        for other in logging.Logger.manager.loggerDict.values():
+            if getattr(other, "_scaletorch_configured", False):
+                _swap_handler_formats(
+                    other, log_format,
+                    getattr(other, "_scaletorch_process_index", 0))
+                other._scaletorch_log_format = log_format
+
     logger = logging.getLogger(name)
     configured = getattr(logger, "_scaletorch_configured", False)
+    fmt = _DEFAULT_FORMAT
     # Re-configure when the caller asks for something the cached setup lacks
     # (e.g. the trainer passing log_file after library modules grabbed the
     # bare logger at import time).
     wants_file = log_file is not None and log_file not in getattr(
         logger, "_scaletorch_log_files", set()
     )
-    if configured and not wants_file:
+    wants_format = fmt != getattr(logger, "_scaletorch_log_format", "text")
+    if configured and not wants_file and not wants_format:
         return logger
 
     process_index = _process_index_noinit()
@@ -101,16 +172,22 @@ def get_logger(
     if not configured:
         use_color = sys.stdout.isatty() and os.environ.get("NO_COLOR") is None
         handler = logging.StreamHandler(sys.stdout)
-        handler.setFormatter(ColorfulFormatter(process_index, use_color))
+        handler.setFormatter(_make_formatter(fmt, process_index, use_color))
         logger.addHandler(handler)
         logger._scaletorch_log_files = set()  # type: ignore[attr-defined]
 
     if wants_file and process_index == 0:
         os.makedirs(os.path.dirname(os.path.abspath(log_file)), exist_ok=True)
         fh = logging.FileHandler(log_file)
-        fh.setFormatter(ColorfulFormatter(process_index, use_color=False))
+        fh.setFormatter(_make_formatter(fmt, process_index, use_color=False))
         logger.addHandler(fh)
         logger._scaletorch_log_files.add(log_file)  # type: ignore[attr-defined]
 
+    if wants_format and configured:
+        # this logger predates the current process-wide format
+        _swap_handler_formats(logger, fmt, process_index)
+
+    logger._scaletorch_log_format = fmt  # type: ignore[attr-defined]
+    logger._scaletorch_process_index = process_index  # type: ignore[attr-defined]
     logger._scaletorch_configured = True  # type: ignore[attr-defined]
     return logger

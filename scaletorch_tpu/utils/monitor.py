@@ -40,14 +40,20 @@ def _read_first_number(path: str) -> Optional[float]:
 
 _ACCEL_HWMON_NAMES = re.compile(r"tpu|accel|apex|npu", re.IGNORECASE)
 
+# The platform sensor tree; a parameter (not a constant reference) so
+# tests can point the reader at a tmpdir-backed fake /sys/class/hwmon.
+_HWMON_GLOB = "/sys/class/hwmon/hwmon*"
 
-def read_accelerator_environment() -> Dict[str, float]:
+
+def read_accelerator_environment(
+        hwmon_glob: Optional[str] = None) -> Dict[str, float]:
     """Power (W) / temperature (C) from whatever the platform exposes.
 
     Checks, in order: hwmon temperature/power channels (present on some
     TPU VM images), then any ``TPU_METRICS_DIR`` text files named
     ``power``/``temp``. Returns {} when nothing is exposed — callers and
-    JSON consumers must treat these fields as optional.
+    JSON consumers must treat these fields as optional: absent, never
+    fabricated.
 
     hwmon channels are attributed to the accelerator (``accel_*``) only
     when the chip's ``name`` file matches an accelerator driver; anything
@@ -55,7 +61,7 @@ def read_accelerator_environment() -> Dict[str, float]:
     CPU temperature can never masquerade as chip telemetry.
     """
     out: Dict[str, float] = {}
-    for hw_dir in sorted(glob.glob("/sys/class/hwmon/hwmon*")):
+    for hw_dir in sorted(glob.glob(hwmon_glob or _HWMON_GLOB)):
         try:
             with open(os.path.join(hw_dir, "name")) as f:
                 chip = f.read().strip()

@@ -546,8 +546,17 @@ class ToyTrainer:
         self.metrics = MetricsLogger(
             num_params=V * H * 2, num_layers=1, num_heads=1, head_dim=H,
             seq_len=SEQ, tokens_per_step=self.loader.tokens_per_step,
-            log_frequency=1000, collect_system=False,
+            log_frequency=cfg.log_frequency, collect_system=False,
         )
+        # telemetry: built from the same config the real Trainer uses
+        # (disabled unless the test sets telemetry_dir), so the
+        # telemetry-aware train loop binds unchanged
+        from scaletorch_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry.from_config(cfg)
+        self._tracer = self.telemetry.tracer
+        self.metrics.exporter = self.telemetry.exporter
+        self._last_data_fetch_s = 0.0
         self.global_step = 0
         self.tokens_seen = 0
         self.preempted = False
@@ -575,6 +584,7 @@ class ToyTrainer:
     def close(self):
         if self._ckpt_mgr is not None:
             self._ckpt_mgr.wait()
+        self.telemetry.close()
 
 
 def _bind_real_trainer_methods():
@@ -583,8 +593,8 @@ def _bind_real_trainer_methods():
     for name in (
         "train", "save_checkpoint", "load_checkpoint",
         "_rollback_to_last_good", "_emergency_checkpoint", "_layer_storage",
-        "_beat", "_stream_position", "_write_crash_report",
-        "_watchdog_crash_report", "_watchdog_exit",
+        "_beat", "_span", "_stream_position", "_write_crash_report",
+        "_watchdog_crash_report", "_watchdog_exit", "_live_snapshot",
         "_agree_all", "_agree_any",
     ):
         setattr(ToyTrainer, name, Trainer.__dict__[name])

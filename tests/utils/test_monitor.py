@@ -1,0 +1,118 @@
+"""utils/monitor.read_accelerator_environment — the platform-sensor
+reader behind the ring buffer's accel_/hwmon_ fields.
+
+Contracts under test (previously untested):
+
+  * attribution — a hwmon chip whose ``name`` matches an accelerator
+    driver reports ``accel_*``; any other chip (coretemp, an NVMe
+    sensor) reports ``hwmon_*`` so a host CPU temperature can never
+    masquerade as chip telemetry;
+  * absent-never-fabricated — nothing exposed means ``{}``, not zeros;
+  * unit scaling — hwmon millidegrees / microwatts to C / W,
+    ``TPU_METRICS_DIR`` sidecar values passed through unscaled;
+  * precedence — first source wins via ``setdefault`` (hwmon accel
+    channels are not overwritten by the sidecar).
+"""
+
+import pytest
+
+from scaletorch_tpu.utils.monitor import read_accelerator_environment
+
+
+def _hwmon(tmp_path, idx, name, temp_milli=None, power_micro=None):
+    d = tmp_path / f"hwmon{idx}"
+    d.mkdir()
+    (d / "name").write_text(f"{name}\n")
+    if temp_milli is not None:
+        (d / "temp1_input").write_text(f"{temp_milli}\n")
+    if power_micro is not None:
+        (d / "power1_average").write_text(f"{power_micro}\n")
+    return d
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_sources(monkeypatch, tmp_path):
+    """Isolate from the host: an empty fake sensor tree and no sidecar
+    unless the test sets one."""
+    monkeypatch.delenv("TPU_METRICS_DIR", raising=False)
+
+
+def test_nothing_exposed_returns_empty(tmp_path):
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {}  # absent, never fabricated — no zero-filled fields
+
+
+def test_accel_chip_attributed_as_accel(tmp_path):
+    _hwmon(tmp_path, 0, "tpu_common", temp_milli=45500, power_micro=12_000_000)
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {"accel_temp_c": 45.5, "accel_power_w": 12.0}
+
+
+@pytest.mark.parametrize("chip", ["apex", "npu_driver", "my-accel-0"])
+def test_accelerator_name_variants_match(tmp_path, chip):
+    _hwmon(tmp_path, 0, chip, temp_milli=30000)
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {"accel_temp_c": 30.0}
+
+
+def test_host_sensor_never_masquerades_as_accel(tmp_path):
+    _hwmon(tmp_path, 0, "coretemp", temp_milli=70000)
+    _hwmon(tmp_path, 1, "nvme", temp_milli=40000, power_micro=3_000_000)
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert "accel_temp_c" not in out and "accel_power_w" not in out
+    # first chip in sorted order wins the hwmon_ slot (setdefault)
+    assert out == {"hwmon_temp_c": 70.0, "hwmon_power_w": 3.0}
+
+
+def test_mixed_chips_attribute_independently(tmp_path):
+    _hwmon(tmp_path, 0, "coretemp", temp_milli=70000)
+    _hwmon(tmp_path, 1, "tpu0", temp_milli=42000)
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {"hwmon_temp_c": 70.0, "accel_temp_c": 42.0}
+
+
+def test_unreadable_name_degrades_to_hwmon(tmp_path):
+    d = tmp_path / "hwmon0"
+    d.mkdir()  # no name file at all
+    (d / "temp1_input").write_text("50000\n")
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {"hwmon_temp_c": 50.0}
+
+
+def test_garbage_sensor_values_are_skipped(tmp_path):
+    _hwmon(tmp_path, 0, "tpu0")
+    (tmp_path / "hwmon0" / "temp1_input").write_text("not-a-number\n")
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {}
+
+
+def test_tpu_metrics_dir_sidecar(tmp_path, monkeypatch):
+    sidecar = tmp_path / "sidecar"
+    sidecar.mkdir()
+    (sidecar / "power").write_text("198.5\n")
+    (sidecar / "temp").write_text("61.25 extra tokens ignored\n")
+    monkeypatch.setenv("TPU_METRICS_DIR", str(sidecar))
+    out = read_accelerator_environment(
+        hwmon_glob=str(tmp_path / "hwmon*"))  # no hwmon chips
+    assert out == {"accel_power_w": 198.5, "accel_temp_c": 61.25}
+
+
+def test_hwmon_accel_wins_over_sidecar(tmp_path, monkeypatch):
+    """Precedence is setdefault: the kernel driver's reading stands;
+    the sidecar only fills channels hwmon did not provide."""
+    _hwmon(tmp_path, 0, "tpu0", temp_milli=42000)
+    sidecar = tmp_path / "sidecar"
+    sidecar.mkdir()
+    (sidecar / "temp").write_text("99.0\n")
+    (sidecar / "power").write_text("150.0\n")
+    monkeypatch.setenv("TPU_METRICS_DIR", str(sidecar))
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {"accel_temp_c": 42.0, "accel_power_w": 150.0}
+
+
+def test_empty_sidecar_dir_fabricates_nothing(tmp_path, monkeypatch):
+    sidecar = tmp_path / "sidecar"
+    sidecar.mkdir()
+    monkeypatch.setenv("TPU_METRICS_DIR", str(sidecar))
+    out = read_accelerator_environment(hwmon_glob=str(tmp_path / "hwmon*"))
+    assert out == {}

@@ -18,6 +18,7 @@ Examples:
 
 from __future__ import annotations
 
+import os
 import sys
 
 
@@ -30,6 +31,14 @@ def main(argv=None) -> int:
 
     cfg = parse_args(argv)
     trainer = Trainer(cfg)
+    if trainer.telemetry.enabled:
+        # the operator contract up front: where the artifacts land and
+        # how to poke a live run (docs/observability.md)
+        get_logger().info(
+            f"telemetry enabled -> {trainer.telemetry.directory} "
+            "(Chrome trace + JSONL event stream; kill -USR1 "
+            f"{os.getpid()} dumps a live snapshot)"
+        )
     # --resume auto: a restarted (e.g. preempted-and-rescheduled) job picks
     # up from the newest readable checkpoint and trains to the SAME
     # total_train_steps target; with no checkpoint yet it starts from
