@@ -29,7 +29,8 @@ ST704  a single collective result exceeds the entry's replication cap
 
 Each entry point's builder lives NEXT TO the entry point it audits
 (``parallel/spmd.audit_entry``, ``trainer/train_step.audit_entry``,
-``inference/decode.audit_entry_prefill``/``_decode``) and returns a
+``inference/decode.audit_entry_prefill``/``_decode``/
+``_paged_decode``) and returns a
 plain dict — the runtime modules never import the analyzer. This module
 imports jax and is only pulled in by the ``--tier deep`` CLI path and
 its tests; the pure-AST tier stays jax-free.
@@ -39,7 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from .core import Finding
 from .hlo import parse_collectives
@@ -55,6 +56,8 @@ MANIFEST: Tuple[Tuple[str, str, str], ...] = (
      "audit_entry_prefill"),
     ("decode_step", "scaletorch_tpu.inference.decode",
      "audit_entry_decode"),
+    ("paged_decode_step", "scaletorch_tpu.inference.decode",
+     "audit_entry_paged_decode"),
 )
 
 # jaxpr primitives that move bytes between mesh members. pvary /

@@ -148,7 +148,23 @@ else:
 
 def pallas_tpu_compiler_params(pltpu_module, **kwargs):
     """``pltpu.CompilerParams`` was ``TPUCompilerParams`` before jax 0.6;
-    build whichever this jax ships."""
+    build whichever this jax ships.
+
+    ``dimension_semantics`` entries are normalized to the string spelling
+    ("parallel"/"arbitrary"): old-jax Mosaic lowering interpolates each
+    entry into an MLIR attribute verbatim, so the ``pltpu.PARALLEL``
+    /``ARBITRARY`` pipeline objects fail attribute parsing there, while
+    the strings are accepted by every jax we support.
+    """
+    dims = kwargs.get("dimension_semantics")
+    if dims is not None:
+        by_id = {
+            id(getattr(pltpu_module, name, None)): name.lower()
+            for name in ("PARALLEL", "ARBITRARY", "CORE_PARALLEL")
+        }
+        kwargs["dimension_semantics"] = tuple(
+            by_id.get(id(d), d) for d in dims
+        )
     cls = getattr(pltpu_module, "CompilerParams", None)
     if cls is None:
         cls = pltpu_module.TPUCompilerParams

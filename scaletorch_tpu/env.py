@@ -88,6 +88,10 @@ register_env("SCALETORCH_TPU_GROUPED_MLP_KERNEL", "0", _as_bool)
 # host-side heuristic).
 register_env("SCALETORCH_TPU_FLASH_BLOCK_Q", "512", int)
 register_env("SCALETORCH_TPU_FLASH_BLOCK_KV", "512", int)
+# Paged-decode attention (ops/pallas/paged_attention.py): 1 (default)
+# lets single-token decode on a TPU backend take the Pallas kernel; 0
+# forces the lax gather fallback everywhere (the bit-parity oracle).
+register_env("SCALETORCH_TPU_PAGED_KERNEL", "1", _as_bool)
 
 # Fault-injection hooks (resilience.FaultInjector): 0 = off. Env overrides
 # the ft_* config fields so a running job can be drilled without a config

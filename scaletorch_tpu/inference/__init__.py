@@ -4,12 +4,18 @@ The serving half of the framework (ROADMAP north star: "serves heavy
 traffic from millions of users"), reusing the training stack's mesh, TP
 sharding specs, and attention math:
 
-  * ``kv_cache``  — per-layer KV caches in the models' scan layout
-    ``[L, B, Hkv, S_max, D]``, head-sharded with the existing TP
-    NamedSharding specs; plus the MLA latent-only cache.
-  * ``decode``    — the two jitted steps (full-prompt prefill, single-
-    token decode) over the models' cache-aware forwards; static shapes,
-    donated cache buffers, two compiles total.
+  * ``kv_cache``  — per-layer KV caches in the models' scan layout:
+    the dense per-slot ``[L, B, Hkv, S_max, D]`` buffers, the MLA
+    latent-only cache, and the PAGED layout — a global pool of
+    fixed-size pages ``[L, n_pages, Hkv, page_size, D]`` with per-slot
+    page tables, a host-side ``PageAllocator`` (free list + refcounts)
+    and a ``RadixPrefixCache`` sharing page-aligned prompt prefixes
+    across requests; all head-sharded with the existing TP
+    NamedSharding specs.
+  * ``decode``    — the jitted steps (full-prompt prefill, single-
+    token decode, dense and paged variants) over the models'
+    cache-aware forwards; static shapes, donated cache buffers, two
+    compiles total per layout.
   * ``sampling``  — greedy / temperature / top-k / top-p with per-slot
     PRNG keys.
   * ``engine``    — continuous batching over a fixed-slot batch: admit
@@ -25,12 +31,20 @@ sharding specs, and attention math:
 from scaletorch_tpu.inference.kv_cache import (  # noqa: F401
     KVCache,
     MLACache,
+    PageAllocator,
+    PagedKVCache,
+    PagedKVIO,
+    RadixPrefixCache,
     init_kv_cache,
     init_mla_cache,
+    init_paged_kv_cache,
     kv_cache_bytes,
     kv_cache_shape,
     kv_cache_shardings,
     kv_cache_specs,
+    paged_kv_cache_shape,
+    paged_kv_cache_shardings,
+    paged_kv_cache_specs,
 )
 from scaletorch_tpu.inference.sampling import (  # noqa: F401
     SamplingParams,
@@ -40,6 +54,8 @@ from scaletorch_tpu.inference.sampling import (  # noqa: F401
 from scaletorch_tpu.inference.decode import (  # noqa: F401
     make_decode_step,
     make_fill_slots_step,
+    make_paged_decode_step,
+    make_paged_prefill_step,
     make_prefill_step,
     resolve_forward_cached,
 )

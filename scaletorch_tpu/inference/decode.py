@@ -158,16 +158,19 @@ def make_decode_step(
 
 
 def make_fill_slots_step(*, donate_cache: Optional[bool] = None) -> Callable:
-    """Build the jitted masked slot-fill over the stacked KV cache.
+    """Build the jitted masked fill over axis 1 of the stacked cache.
 
-    fill_slots(cache, mask [B] bool, value scalar) -> cache with every
-    masked slot's cache lines set to ``value`` along the batch axis
-    (axis 1 of the [L, B, Hkv, S_max, D] buffers); unmasked slots' bytes
-    pass through bit-identical.
+    fill_slots(cache, mask bool, value scalar) -> cache with every
+    masked index's lines along axis 1 set to ``value``; unmasked bytes
+    pass through bit-identical. Axis 1 is the SLOT axis of the dense
+    [L, B, Hkv, S_max, D] buffers and the PAGE axis of the paged
+    [L, n_pages, Hkv, page_size, D] pools — the same compiled step
+    serves both layouts (the engine clears whole slots dense, whole
+    pages paged).
 
     One compile serves both consumers — quarantine hygiene (value 0:
     a retired poison slot's NaN K/V must not outlive the request) and
-    fault injection (value NaN: poison one slot's cache so its next
+    fault injection (value NaN: poison a slot's cache lines so its next
     decode step goes non-finite) — because the mask and the fill value
     are data, never shapes. The cache is donated like the engine steps,
     so XLA rewrites the masked lanes in place.
@@ -178,12 +181,171 @@ def make_fill_slots_step(*, donate_cache: Optional[bool] = None) -> Callable:
             m = mask.reshape((1, mask.shape[0]) + (1,) * (buf.ndim - 2))
             return jnp.where(m, jnp.asarray(value, buf.dtype), buf)
 
-        return KVCache(*(fill(buf) for buf in cache))
+        return type(cache)(*(fill(buf) for buf in cache))
 
     return jax.jit(
         fill_slots,
         donate_argnums=(0,) if _resolve_donate(donate_cache) else (),
     )
+
+
+# ---------------------------------------------------------------------------
+# paged-cache steps (ISSUE 10)
+# ---------------------------------------------------------------------------
+def make_paged_prefill_step(
+    cfg,
+    sampling: SamplingParams,
+    *,
+    page_size: int,
+    seq_limit: Optional[int] = None,
+    forward_fn: Optional[Callable] = None,
+    donate_cache: Optional[bool] = None,
+) -> Callable:
+    """Build the jitted paged prefill step.
+
+    prefill(params, tokens [B, P], tail_lens [B], starts [B],
+            write_mask [B] bool, page_tables [B, max_pages] i32,
+            pool (PagedKVCache), base_keys [B, 2])
+      -> (first_token [B] i32, last_logits [B, V] f32, finite [B] bool,
+          new_pool)
+
+    The paged twist on ``make_prefill_step``: each admitted slot
+    prefills only its NON-SHARED prompt tail. ``starts`` is the
+    page-aligned count of tokens already cached via a radix prefix hit
+    (0 without one); the tail tokens sit at buffer rows [0, tail_len)
+    and run at absolute positions ``starts + row`` — their attention
+    reads the shared prefix pages straight out of the pool through the
+    page table, so the shared positions cost ZERO forward compute.
+    Writes land in the slot's own pages only (prefix sharing is
+    page-aligned and shared pages are frozen); rows past ``tail_len``
+    write garbage into the slot's own later pages or the TRASH page,
+    invisible for the same reason the dense buffer's garbage is. The
+    first token samples from the logits at row ``tail_len - 1`` with
+    the slot's (seed, prompt_len - 1) key — bit-identical to the dense
+    engine's first sample.
+    """
+    fwd = forward_fn or resolve_forward_cached(cfg)
+
+    def prefill(params, tokens, tail_lens, starts, write_mask,
+                page_tables, pool, base_keys):
+        from scaletorch_tpu.inference.kv_cache import PagedKVCache, PagedKVIO
+
+        b, p = tokens.shape
+        positions = starts[:, None] + jnp.broadcast_to(
+            jnp.arange(p, dtype=jnp.int32), (b, p))
+        kv_io = PagedKVIO(page_tables, page_size, seq_limit=seq_limit)
+        logits, new_pool = fwd(
+            params, tokens, cfg, tuple(pool),
+            positions=positions, write_mask=write_mask, kv_io=kv_io,
+        )
+        last = jnp.take_along_axis(
+            logits, (tail_lens - 1)[:, None, None], axis=1
+        )[:, 0, :]
+        keys = slot_keys(base_keys, starts + tail_lens - 1)
+        first = sample(last, keys, sampling)
+        return (first, last.astype(jnp.float32), finite_mask(last),
+                PagedKVCache(*new_pool))
+
+    return jax.jit(
+        prefill, donate_argnums=(6,) if _resolve_donate(donate_cache) else ()
+    )
+
+
+def make_paged_decode_step(
+    cfg,
+    sampling: SamplingParams,
+    *,
+    page_size: int,
+    seq_limit: Optional[int] = None,
+    forward_fn: Optional[Callable] = None,
+    donate_cache: Optional[bool] = None,
+) -> Callable:
+    """Build the jitted paged single-token decode step.
+
+    decode(params, tokens [B] i32, positions [B] i32, active [B] bool,
+           page_tables [B, max_pages] i32, pool (PagedKVCache),
+           base_keys [B, 2])
+      -> (next_token [B] i32, logits [B, V] f32, finite [B] bool,
+          new_pool)
+
+    Identical contract to ``make_decode_step`` with the cache reads
+    routed through the page table: the K/V append is a scatter into the
+    slot's current page and attention is a gather over its table (the
+    Pallas paged-decode kernel on TPU, the lax gather fallback on
+    CPU/interpret/old-jax — ops/pallas/paged_attention.py). Page-table
+    contents are DATA: admissions, prefix hits, quarantine clears, and
+    frees all mutate tables host-side and this one compile serves them
+    all.
+    """
+    fwd = forward_fn or resolve_forward_cached(cfg)
+
+    def decode(params, tokens, positions, active, page_tables, pool,
+               base_keys):
+        from scaletorch_tpu.inference.kv_cache import PagedKVCache, PagedKVIO
+
+        kv_io = PagedKVIO(page_tables, page_size, seq_limit=seq_limit)
+        logits, new_pool = fwd(
+            params, tokens[:, None], cfg, tuple(pool),
+            positions=positions[:, None], write_mask=active, kv_io=kv_io,
+        )
+        step_logits = logits[:, 0, :]
+        keys = slot_keys(base_keys, positions)
+        nxt = sample(step_logits, keys, sampling)
+        return (nxt, step_logits.astype(jnp.float32),
+                finite_mask(step_logits), PagedKVCache(*new_pool))
+
+    return jax.jit(
+        decode, donate_argnums=(5,) if _resolve_donate(donate_cache) else ()
+    )
+
+
+def teacher_forced_decode_paged(
+    params,
+    cfg,
+    tokens: jax.Array,
+    *,
+    page_size: int,
+    max_seq: Optional[int] = None,
+    prefill_len: int = 1,
+    forward_fn: Optional[Callable] = None,
+    dtype=None,
+) -> jax.Array:
+    """Paged twin of ``teacher_forced_decode``: the same prefill-then-
+    teacher-forced-decode schedule run against a page pool through an
+    identity page table (slot ``b`` owns pages ``b*max_pages+1 ..``,
+    page 0 reserved as TRASH). Returns [B, S, V] logits — the parity
+    oracle proving the paged read/write path is positionally identical
+    to the dense cache, layer by layer, token by token."""
+    import numpy as np
+
+    from scaletorch_tpu.inference.kv_cache import (
+        PagedKVIO,
+        ceil_div,
+        init_paged_kv_cache,
+    )
+
+    fwd = forward_fn or resolve_forward_cached(cfg)
+    b, s = tokens.shape
+    s_max = max_seq or s
+    max_pages = ceil_div(s_max, page_size)
+    pool = init_paged_kv_cache(
+        cfg, b * max_pages + 1, page_size,
+        dtype=dtype or getattr(cfg, "dtype", None))
+    tables = (np.arange(b * max_pages, dtype=np.int32) + 1).reshape(
+        b, max_pages)
+    kv_io = PagedKVIO(jnp.asarray(tables), page_size, seq_limit=s_max)
+    p = prefill_len
+    positions = jnp.broadcast_to(jnp.arange(p, dtype=jnp.int32), (b, p))
+    logits_p, pool = fwd(params, tokens[:, :p], cfg, tuple(pool),
+                         positions=positions, kv_io=kv_io)
+    chunks = [logits_p]
+    for t in range(p, s):
+        logits_t, pool = fwd(
+            params, tokens[:, t:t + 1], cfg, tuple(pool),
+            positions=jnp.full((b, 1), t, jnp.int32), kv_io=kv_io,
+        )
+        chunks.append(logits_t)
+    return jnp.concatenate(chunks, axis=1)
 
 
 def _audit_cfg_and_cache():
@@ -254,6 +416,47 @@ def audit_entry_decode():
     )
     return {
         "name": "decode_step",
+        "file": "scaletorch_tpu/inference/decode.py",
+        "fn": fn,
+        "args": args,
+        "min_devices": 1,
+        "quantized_axis": None,
+        "expect_donation": True,
+        "hoisted_axes": (),
+        "max_collective_result_mb": 1.0,
+    }
+
+
+def audit_entry_paged_decode():
+    """Deep-tier audit target: the jitted paged one-token decode step on
+    one device. Contract: donation of the PAGE POOL survives lowering
+    (the pool is the whole serving cache — losing the alias doubles
+    serving HBM per step) and the single-device step compiles to ZERO
+    collectives (empty budget row in tools/comm_budget.json, like the
+    dense steps)."""
+    from scaletorch_tpu.inference.kv_cache import init_paged_kv_cache
+
+    cfg, params, _, base_keys, b, s_max = _audit_cfg_and_cache()
+    page_size = 8
+    max_pages = s_max // page_size
+    num_pages = b * max_pages + 1
+    pool = jax.eval_shape(
+        lambda: init_paged_kv_cache(
+            cfg, num_pages, page_size, dtype=jnp.float32))
+    fn = make_paged_decode_step(
+        cfg, SamplingParams(temperature=0.0), page_size=page_size,
+        seq_limit=s_max, donate_cache=True)
+    args = (
+        params,
+        jax.ShapeDtypeStruct((b,), jnp.int32),             # tokens
+        jax.ShapeDtypeStruct((b,), jnp.int32),             # positions
+        jax.ShapeDtypeStruct((b,), jnp.bool_),             # active
+        jax.ShapeDtypeStruct((b, max_pages), jnp.int32),   # page tables
+        pool,
+        base_keys,
+    )
+    return {
+        "name": "paged_decode_step",
         "file": "scaletorch_tpu/inference/decode.py",
         "fn": fn,
         "args": args,

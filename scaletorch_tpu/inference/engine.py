@@ -13,7 +13,17 @@ TPU execution discipline:
     bit — data changes, shapes don't, nothing retraces;
   * the KV cache is donated through every step (XLA appends in place);
     with a mesh it is head-sharded over ``tp`` via the same specs the
-    training params use (kv_cache_specs), and the steps run GSPMD.
+    training params use (kv_cache_specs), and the steps run GSPMD;
+  * ``cache_layout="paged"`` swaps the dense per-slot buffers for a
+    global page pool + per-slot page tables (kv_cache.PagedKVCache):
+    admission becomes page-budget-aware (HBM scales with tokens cached,
+    not B x S_max), a radix tree shares page-aligned prompt prefixes
+    across requests (refcounted, copy-on-write at the page boundary),
+    and decode attention gathers through the table (the Pallas kernel
+    in ops/pallas/paged_attention.py on TPU, the lax fallback
+    elsewhere) — greedy outputs stay bit-identical to the dense layout
+    and the tables are data, so the one-compile discipline survives
+    admissions, prefix hits, quarantine page-clears, and frees.
 
 Serving-grade fault tolerance (inference/resilience.py) rides the same
 discipline: every submitted request ends in exactly one terminal
@@ -48,10 +58,17 @@ import numpy as np
 from scaletorch_tpu.inference.decode import (
     make_decode_step,
     make_fill_slots_step,
+    make_paged_decode_step,
+    make_paged_prefill_step,
     make_prefill_step,
 )
 from scaletorch_tpu.inference.kv_cache import (
+    PageAllocator,
+    RadixPrefixCache,
+    TRASH_PAGE,
+    ceil_div,
     init_kv_cache,
+    init_paged_kv_cache,
     kv_cache_bytes,
 )
 from scaletorch_tpu.inference.resilience import (
@@ -113,12 +130,21 @@ class EngineMetrics:
 
     requests_submitted: int = 0
     requests_completed: int = 0     # ok outcomes only
+    requests_admitted: int = 0      # entered a slot (prefilled)
     tokens_generated: int = 0
     prefill_calls: int = 0
     decode_steps: int = 0
     queue_depth: int = 0
     active_slots: int = 0
     num_slots: int = 0
+    # paged-cache gauges/counters (zero on the dense layout): pool
+    # occupancy plus the radix prefix-cache's yield — an admission whose
+    # prompt head was already cached is a ``prefix_hit`` and its shared
+    # tokens (never re-prefilled) accumulate in ``prefill_tokens_saved``
+    pages_in_use: int = 0
+    page_pool_free: int = 0
+    prefix_hits: int = 0
+    prefill_tokens_saved: int = 0
     ttft_sum_s: float = 0.0
     ttft_count: int = 0
     outcomes: Dict[str, int] = field(
@@ -165,6 +191,13 @@ class EngineMetrics:
             "quarantine_rate": (
                 self.outcomes["quarantined"] / terminal if terminal else 0.0
             ),
+            "pages_in_use": self.pages_in_use,
+            "page_pool_free": self.page_pool_free,
+            "prefix_hit_rate": (
+                self.prefix_hits / self.requests_admitted
+                if self.requests_admitted else 0.0
+            ),
+            "prefill_tokens_saved": self.prefill_tokens_saved,
         }
         for outcome, count in self.outcomes.items():
             snap[f"requests_{outcome}"] = count
@@ -204,8 +237,26 @@ class InferenceEngine:
         ``max_seq``); prompts longer than this are rejected.
     sampling : engine-wide sampling knobs (static, baked into the
         compiled steps).
+    cache_layout : ``"dense"`` (default, per-slot [L,B,Hkv,S_max,D]
+        buffers) or ``"paged"`` — a global pool of fixed-size pages
+        [L,n_pages,Hkv,page_size,D] plus per-slot page tables. Paged,
+        admission is PAGE-BUDGET-aware: a request is admitted when the
+        pool can cover ``min(prompt + max_new_tokens, max_seq)`` tokens
+        of pages (minus any radix prefix hit), not when a slot index
+        frees up — HBM scales with tokens actually cached, and
+        concurrency with the pool, not with ``B × S_max``.
+    page_size : tokens per page (paged layout only).
+    num_pages : pool size including the reserved TRASH page. None sizes
+        the dense-equivalent pool (``max_slots * ceil(max_seq /
+        page_size) + 1``); smaller pools trade concurrency for HBM.
+    prefix_cache : paged only — keep a radix tree over page-aligned
+        token prefixes so a request whose prompt head is already cached
+        shares those pages (refcounted, copy-on-write at the page
+        boundary) and prefills only its tail.
     mesh / tp_axis / batch_axis : optional — shard the cache over the
-        mesh (KV heads over ``tp_axis``, slots over ``batch_axis``).
+        mesh (KV heads over ``tp_axis``, slots over ``batch_axis``;
+        the paged pool shards KV heads the same way, ``batch_axis``
+        is dense-only — pages are not slot-aligned).
     monitor : optional SystemMonitor; ``step()`` samples the metrics
         snapshot into its ring buffer every ``monitor_every`` steps.
     tracer : optional ``telemetry.SpanTracer``; each tick records
@@ -248,6 +299,10 @@ class InferenceEngine:
         prefill_len: Optional[int] = None,
         sampling: SamplingParams = SamplingParams(),
         cache_dtype: Any = None,
+        cache_layout: str = "dense",
+        page_size: int = 16,
+        num_pages: Optional[int] = None,
+        prefix_cache: bool = True,
         mesh: Any = None,
         tp_axis: str = "tp",
         batch_axis: Optional[str] = None,
@@ -299,26 +354,102 @@ class InferenceEngine:
         self.preemption = preemption
         self.watchdog = watchdog
 
-        sharding = None
-        if mesh is not None:
-            from scaletorch_tpu.inference.kv_cache import kv_cache_shardings
+        if cache_layout not in ("dense", "paged"):
+            raise ValueError(
+                f"cache_layout must be 'dense' or 'paged', "
+                f"got {cache_layout!r}"
+            )
+        self.cache_layout = cache_layout
+        self._paged = cache_layout == "paged"
+        if self._paged and page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self._pages_per_slot = (
+            ceil_div(max_seq, page_size) if self._paged else 0)
+        if num_pages is None and self._paged:
+            num_pages = max_slots * self._pages_per_slot + 1
+        self.num_pages = num_pages
 
-            sharding = kv_cache_shardings(
-                mesh, tp_axis=tp_axis, batch_axis=batch_axis)
-        self.cache = init_kv_cache(
-            cfg, max_slots, max_seq, dtype=cache_dtype, sharding=sharding)
-        logger.info(
-            "inference engine: %d slots x %d positions, cache %.1f MiB%s",
-            max_slots, max_seq,
-            kv_cache_bytes(cfg, max_slots, max_seq,
-                           dtype=cache_dtype) / 2**20,
-            f", sharded over {mesh.axis_names}" if mesh is not None else "",
-        )
+        if self._paged:
+            from scaletorch_tpu.inference.kv_cache import (
+                paged_kv_cache_shardings,
+            )
 
-        self._prefill = make_prefill_step(
-            cfg, sampling, forward_fn=forward_fn, donate_cache=donate_cache)
-        self._decode = make_decode_step(
-            cfg, sampling, forward_fn=forward_fn, donate_cache=donate_cache)
+            sharding = (
+                paged_kv_cache_shardings(mesh, tp_axis=tp_axis)
+                if mesh is not None else None
+            )
+            self.cache = init_paged_kv_cache(
+                cfg, num_pages, page_size, dtype=cache_dtype,
+                sharding=sharding)
+            self.allocator = PageAllocator(num_pages)
+            self.radix = (
+                RadixPrefixCache(
+                    page_size, self.allocator.retain, self.allocator.release,
+                    self.allocator.refcount,
+                ) if prefix_cache else None
+            )
+            # per-slot page table (host copy; reaches the device as data
+            # every step), the pages each slot holds a reference on
+            # (shared prefix pages first, own pages after), and how many
+            # leading table entries are FROZEN — shared or
+            # radix-registered, so exempt from quarantine clears/pokes
+            self._tables = np.full(
+                (max_slots, self._pages_per_slot), TRASH_PAGE, np.int32)
+            # device copy of the tables, re-uploaded only after a host
+            # write (admission/retire) — the decode hot loop reads it
+            # every tick and must not pay a H2D transfer per token
+            self._tables_dev = None
+            self._slot_pages: List[List[int]] = [[] for _ in range(max_slots)]
+            self._slot_frozen = [0] * max_slots
+            cache_mib = kv_cache_bytes(
+                cfg, max_slots, max_seq, dtype=cache_dtype, layout="paged",
+                page_size=page_size, num_pages=num_pages) / 2**20
+            logger.info(
+                "inference engine: %d slots over %d pages x %d tokens, "
+                "pool %.1f MiB%s%s",
+                max_slots, num_pages, page_size, cache_mib,
+                ", prefix cache on" if prefix_cache else "",
+                f", sharded over {mesh.axis_names}" if mesh is not None
+                else "",
+            )
+        else:
+            sharding = None
+            if mesh is not None:
+                from scaletorch_tpu.inference.kv_cache import (
+                    kv_cache_shardings,
+                )
+
+                sharding = kv_cache_shardings(
+                    mesh, tp_axis=tp_axis, batch_axis=batch_axis)
+            self.cache = init_kv_cache(
+                cfg, max_slots, max_seq, dtype=cache_dtype, sharding=sharding)
+            self.allocator = None
+            self.radix = None
+            logger.info(
+                "inference engine: %d slots x %d positions, cache %.1f "
+                "MiB%s",
+                max_slots, max_seq,
+                kv_cache_bytes(cfg, max_slots, max_seq,
+                               dtype=cache_dtype) / 2**20,
+                f", sharded over {mesh.axis_names}" if mesh is not None
+                else "",
+            )
+
+        if self._paged:
+            self._prefill = make_paged_prefill_step(
+                cfg, sampling, page_size=page_size, seq_limit=max_seq,
+                forward_fn=forward_fn, donate_cache=donate_cache)
+            self._decode = make_paged_decode_step(
+                cfg, sampling, page_size=page_size, seq_limit=max_seq,
+                forward_fn=forward_fn, donate_cache=donate_cache)
+        else:
+            self._prefill = make_prefill_step(
+                cfg, sampling, forward_fn=forward_fn,
+                donate_cache=donate_cache)
+            self._decode = make_decode_step(
+                cfg, sampling, forward_fn=forward_fn,
+                donate_cache=donate_cache)
         self._fill_slots = make_fill_slots_step(donate_cache=donate_cache)
 
         self._slots = [_Slot() for _ in range(max_slots)]
@@ -329,12 +460,32 @@ class InferenceEngine:
         self._base_keys = np.zeros((max_slots, 2), np.uint32)
         self._draining = False
         self.metrics = EngineMetrics(num_slots=max_slots)
+        if self._paged:
+            self._update_page_gauges()
         # progress fingerprint of the last JSONL export: an idle engine
         # polled at a cadence multiple (or a drain() straight after
         # run()) must not append duplicate records — but any outcome
         # movement (e.g. a queued request timing out on an idle tick)
         # still must
         self._exported_key = self._export_key()
+
+    def _update_page_gauges(self) -> None:
+        self.metrics.pages_in_use = self.allocator.used_count
+        self.metrics.page_pool_free = self.allocator.free_count
+
+    def _tables_device(self):
+        """The page tables as a device array, uploaded once per host
+        mutation rather than once per decode tick."""
+        if self._tables_dev is None:
+            self._tables_dev = jnp.asarray(self._tables)
+        return self._tables_dev
+
+    def _request_pages(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Worst-case pages a request reserves: every position it can
+        write — prompt plus generation, capped by ``max_seq`` (the
+        engine retires at the cap before feeding past it)."""
+        total = min(prompt_len + max_new_tokens, self.max_seq)
+        return ceil_div(total, self.page_size)
 
     def _span(self, name: str, **args):
         """Telemetry span when a tracer is attached, shared no-op
@@ -415,6 +566,14 @@ class InferenceEngine:
                 f"prompt length {len(prompt)} leaves no room to generate "
                 f"within max_seq {self.max_seq}"
             )
+        elif (self._paged and self._request_pages(len(prompt), max_new_tokens)
+                > self.allocator.capacity):
+            err = (
+                f"request needs {self._request_pages(len(prompt), max_new_tokens)} "
+                f"pages but the pool's capacity is {self.allocator.capacity}; "
+                "re-create the engine with more num_pages or cap "
+                "max_new_tokens"
+            )
         if err is not None and self.strict_submit:
             raise EngineDraining(err) if self._draining else ValueError(err)
         req = Request(
@@ -492,6 +651,17 @@ class InferenceEngine:
         )
         slot.request = None
         slot.tokens = []
+        if self._paged:
+            # drop the slot's references; pages shared with live slots or
+            # pinned by the radix tree survive (refcount > 1), the rest
+            # return to the free list
+            for p in self._slot_pages[i]:
+                self.allocator.release(p)
+            self._slot_pages[i] = []
+            self._slot_frozen[i] = 0
+            self._tables[i, :] = TRASH_PAGE
+            self._tables_dev = None
+            self._update_page_gauges()
 
     def _expire(self, now: float) -> None:
         """Deadline sweep: retire queued and mid-decode requests whose
@@ -520,13 +690,26 @@ class InferenceEngine:
         """Retire poisoned slots (non-finite logits) and mask-clear their
         cache lines so the NaN K/V cannot outlive the request. The clear
         is one jitted masked fill over the whole cache — data-only, so
-        the decode step's single compile survives the fault."""
-        mask = np.zeros(self.max_slots, bool)
-        for i in indices:
-            self._retire_slot(
-                i, "quarantined",
-                detail=f"non-finite logits at {where}", now=now)
-            mask[i] = True
+        the decode step's single compile survives the fault. Paged, the
+        mask covers the slot's MUTABLE pages only (own pages past the
+        frozen prefix): frozen pages are immutable since registration —
+        written once by a healthy prefill — so the NaN cannot live there,
+        and clearing them would corrupt the slots sharing them."""
+        if self._paged:
+            mask = np.zeros(self.num_pages, bool)
+            for i in indices:
+                mutable = self._slot_pages[i][self._slot_frozen[i]:]
+                mask[mutable] = True
+                self._retire_slot(
+                    i, "quarantined",
+                    detail=f"non-finite logits at {where}", now=now)
+        else:
+            mask = np.zeros(self.max_slots, bool)
+            for i in indices:
+                self._retire_slot(
+                    i, "quarantined",
+                    detail=f"non-finite logits at {where}", now=now)
+                mask[i] = True
         self.cache = self._fill_slots(
             self.cache, jnp.asarray(mask), jnp.asarray(0.0, jnp.float32))
 
@@ -541,8 +724,18 @@ class InferenceEngine:
             return
         if slot_idx not in active:
             slot_idx = active[0]
-        mask = np.zeros(self.max_slots, bool)
-        mask[slot_idx] = True
+        if self._paged:
+            # NaN the slot's mutable pages only — frozen prefix pages may
+            # be shared, and poisoning them would fault the neighbours
+            # the drill asserts are unaffected. (With a page-aligned
+            # prompt the poke surfaces from the second decode on: until
+            # then the only mutable lane is overwritten fresh each step.)
+            mask = np.zeros(self.num_pages, bool)
+            mutable = self._slot_pages[slot_idx][self._slot_frozen[slot_idx]:]
+            mask[mutable] = True
+        else:
+            mask = np.zeros(self.max_slots, bool)
+            mask[slot_idx] = True
         self.cache = self._fill_slots(
             self.cache, jnp.asarray(mask),
             jnp.asarray(float("nan"), jnp.float32))
@@ -552,6 +745,23 @@ class InferenceEngine:
         batched prefill call regardless of how many were admitted. A
         slot whose prefill logits are non-finite (poison prompt) is
         quarantined immediately; the other admitted slots proceed."""
+        if self._paged:
+            self._admit_paged()
+        else:
+            self._admit_dense()
+
+    def _bind_slot(self, i: int, req: Request) -> None:
+        slot = self._slots[i]
+        slot.request = req
+        slot.tokens = list(req.prompt)
+        slot.position = len(req.prompt)
+        slot.generated = 0
+        slot.first_token_t = None
+        self._base_keys[i] = np.asarray(
+            jax.random.PRNGKey(req.seed), np.uint32)
+        self.metrics.requests_admitted += 1
+
+    def _admit_dense(self) -> None:
         free = [i for i, s in enumerate(self._slots) if not s.active]
         if not free or not self._queue:
             return
@@ -563,17 +773,10 @@ class InferenceEngine:
             if not self._queue:
                 break
             req = self._queue.popleft()
-            slot = self._slots[i]
-            slot.request = req
-            slot.tokens = list(req.prompt)
-            slot.position = len(req.prompt)
-            slot.generated = 0
-            slot.first_token_t = None
+            self._bind_slot(i, req)
             tokens[i, : len(req.prompt)] = req.prompt
             lengths[i] = len(req.prompt)
             write_mask[i] = True
-            self._base_keys[i] = np.asarray(
-                jax.random.PRNGKey(req.seed), np.uint32)
             admitted.append(i)
         with self._span("prefill", admitted=len(admitted)):
             first, _logits, finite, self.cache = self._prefill(
@@ -591,6 +794,109 @@ class InferenceEngine:
         for i in admitted:
             if finite[i]:
                 self._emit(i, int(first[i]), now)
+        self.metrics.queue_depth = len(self._queue)
+
+    def _reserve_pages(self, req: Request):
+        """Try to reserve the pages one request needs: radix-match its
+        prompt, retain the shared prefix pages, allocate the rest
+        (evicting unpinned radix leaves when the free list runs short).
+        Returns (shared_tokens, page_list) or None when the pool cannot
+        cover the request right now — pages free as slots retire, so the
+        request just waits at the head of the queue (FIFO)."""
+        plen = len(req.prompt)
+        ps = self.page_size
+        total_pages = self._request_pages(plen, req.max_new_tokens)
+        shared = 0
+        shared_pages: List[int] = []
+        if self.radix is not None:
+            matched, pages = self.radix.match(req.prompt)
+            # never share the whole prompt: the first token samples from
+            # the logits at prompt_len - 1, so at least one tail token
+            # must run through prefill
+            shared = min(matched, ((plen - 1) // ps) * ps)
+            shared_pages = pages[: shared // ps]
+            for p in shared_pages:
+                self.allocator.retain(p)
+        own_needed = total_pages - len(shared_pages)
+        own = self.allocator.alloc(own_needed)
+        if own is None and self.radix is not None:
+            self.radix.evict(own_needed - self.allocator.free_count)
+            own = self.allocator.alloc(own_needed)
+        if own is None:
+            for p in shared_pages:
+                self.allocator.release(p)
+            return None
+        return shared, shared_pages + own
+
+    def _admit_paged(self) -> None:
+        free = [i for i, s in enumerate(self._slots) if not s.active]
+        if not free or not self._queue:
+            return
+        admitted: List[int] = []
+        tokens = np.zeros((self.max_slots, self.prefill_len), np.int32)
+        tail_lens = np.ones(self.max_slots, np.int32)
+        starts = np.zeros(self.max_slots, np.int32)
+        write_mask = np.zeros(self.max_slots, bool)
+        for i in free:
+            if not self._queue:
+                break
+            reserved = self._reserve_pages(self._queue[0])
+            if reserved is None:
+                break  # page budget exhausted: head of the line waits
+            req = self._queue.popleft()
+            shared, pages = reserved
+            self._bind_slot(i, req)
+            self._slot_pages[i] = pages
+            self._slot_frozen[i] = shared // self.page_size
+            self._tables[i, :] = TRASH_PAGE
+            self._tables[i, : len(pages)] = pages
+            self._tables_dev = None
+            tail = req.prompt[shared:]
+            tokens[i, : len(tail)] = tail
+            tail_lens[i] = len(tail)
+            starts[i] = shared
+            write_mask[i] = True
+            if shared:
+                self.metrics.prefix_hits += 1
+                self.metrics.prefill_tokens_saved += shared
+            admitted.append(i)
+        if not admitted:
+            return
+        with self._span("prefill", admitted=len(admitted)):
+            first, _logits, finite, self.cache = self._prefill(
+                self.params, jnp.asarray(tokens), jnp.asarray(tail_lens),
+                jnp.asarray(starts), jnp.asarray(write_mask),
+                self._tables_device(), self.cache,
+                jnp.asarray(self._base_keys),
+            )
+        self.metrics.prefill_calls += 1
+        now = time.monotonic()
+        first = np.asarray(first)
+        finite = np.asarray(finite)
+        poisoned = [i for i in admitted if not finite[i]]
+        if poisoned:
+            # skip radix registration for poison prompts — their pages
+            # hold non-finite K/V and must never be shared
+            self._quarantine(poisoned, now, where="prefill")
+        for i in admitted:
+            if not finite[i]:
+                continue
+            if self.radix is not None:
+                slot = self._slots[i]
+                plen = len(slot.request.prompt)
+                frozen = (plen // self.page_size) * self.page_size
+                if frozen:
+                    n = frozen // self.page_size
+                    self.radix.insert(
+                        slot.request.prompt[:frozen],
+                        [int(p) for p in self._tables[i, :n]],
+                    )
+                    # the fully-written prompt pages are immutable from
+                    # here on — exempt from quarantine clears and
+                    # shareable by later admissions
+                    self._slot_frozen[i] = n
+            self._emit(i, int(first[i]), now)
+        self._update_page_gauges()
         self.metrics.queue_depth = len(self._queue)
 
     def _emit(self, i: int, token: int, now: float) -> None:
@@ -666,11 +972,16 @@ class InferenceEngine:
                     tokens[i] = slot.tokens[-1]
                     positions[i] = slot.position + slot.generated - 1
                     active[i] = True
+                # the paged step takes the page tables between the slot
+                # mask and the cache; the dense signature is otherwise
+                # identical
+                tables = (
+                    (self._tables_device(),) if self._paged else ())
                 with self._span("decode", active=len(active_idx)):
                     nxt, _logits, finite, self.cache = self._decode(
                         self.params, jnp.asarray(tokens),
-                        jnp.asarray(positions),
-                        jnp.asarray(active), self.cache,
+                        jnp.asarray(positions), jnp.asarray(active),
+                        *tables, self.cache,
                         jnp.asarray(self._base_keys),
                     )
                 self.metrics.decode_steps += 1

@@ -18,15 +18,36 @@ serving path works on any jax new enough for NamedSharding.
 MLA models cache only the low-rank latent (``MLACache``,
 [B, S_max, kv_rank]) and re-expand K/V per step — the trade the variant
 documents (models/attention/variants.py MultiHeadLatentAttention).
+
+Paged layout (ISSUE 10): ``PagedKVCache`` replaces the dense per-slot
+buffers with a global pool of fixed-size pages
+``[L, n_pages, Hkv, page_size, D]`` plus per-slot page tables
+(``[B, max_pages]`` int32, TRASH_PAGE-padded). Slots reserve only the
+pages their request can actually touch — HBM scales with tokens cached,
+not ``B × S_max`` — and requests sharing a token prefix share pages:
+``PageAllocator`` (host-side free list + refcounts) and
+``RadixPrefixCache`` (page-granular radix tree over token chunks) keep
+the bookkeeping; ``PagedKVIO`` adapts the models' cache-aware forwards
+to the paged pool (ops/pallas/paged_attention.py holds the gather /
+scatter primitives and the Pallas decode kernel). Sharding mirrors the
+dense layout: the KV-head axis over the same ``tp`` mesh axis
+(``paged_kv_cache_specs``).
 """
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Tuple
+from collections import deque
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from scaletorch_tpu.ops.pallas.paged_attention import (
+    TRASH_PAGE,
+    paged_attention,
+    paged_write_kv,
+)
 
 
 class KVCache(NamedTuple):
@@ -58,10 +79,37 @@ def kv_cache_shape(cfg, batch: int, max_seq: int) -> Tuple[int, ...]:
     raise TypeError(f"no KV-cache layout known for config {type(cfg).__name__}")
 
 
-def kv_cache_bytes(cfg, batch: int, max_seq: int, dtype: Any = None) -> int:
+def kv_cache_bytes(
+    cfg,
+    batch: int,
+    max_seq: int,
+    dtype: Any = None,
+    *,
+    layout: str = "dense",
+    page_size: Optional[int] = None,
+    num_pages: Optional[int] = None,
+) -> int:
     """Total cache footprint (both buffers) — the capacity-planning number
-    the engine logs at startup."""
-    shape = kv_cache_shape(cfg, batch, max_seq)
+    the engine logs at startup and the bench HBM column reports.
+
+    Layout-aware: ``dense`` is the per-slot ``[L, B, Hkv, S_max, D]``
+    pair (``batch × max_seq`` positions reserved whether used or not);
+    ``paged`` is the page pool ``[L, n_pages, Hkv, page_size, D]`` pair —
+    pass ``page_size`` and ``num_pages`` (``batch``/``max_seq`` then only
+    size the default pool when ``num_pages`` is None: the
+    dense-equivalent ``batch * ceil(max_seq / page_size)`` + trash).
+    """
+    if layout == "paged":
+        if not page_size or page_size < 1:
+            raise ValueError(
+                f"paged layout needs page_size >= 1, got {page_size}")
+        if num_pages is None:
+            num_pages = batch * ceil_div(max_seq, page_size) + 1
+        shape = paged_kv_cache_shape(cfg, num_pages, page_size)
+    elif layout == "dense":
+        shape = kv_cache_shape(cfg, batch, max_seq)
+    else:
+        raise ValueError(f"unknown cache layout {layout!r}")
     dt = jnp.dtype(dtype or getattr(cfg, "dtype", jnp.bfloat16))
     n = 1
     for d in shape:
@@ -125,3 +173,339 @@ def init_mla_cache(attn_cfg, batch: int, max_seq: int,
     return MLACache(latent=jnp.zeros(
         (batch, max_seq, attn_cfg.kv_lora_rank), dtype or attn_cfg.dtype
     ))
+
+
+# ---------------------------------------------------------------------------
+# paged layout (ISSUE 10)
+# ---------------------------------------------------------------------------
+def ceil_div(a: int, b: int) -> int:
+    """Page-count rounding, shared by every pages-for-N-tokens site
+    (engine admission, decode step shapes, bench sizing)."""
+    return -(-a // b)
+
+
+class PagedKVCache(NamedTuple):
+    """Stacked page pools, each [L, n_pages, Hkv, page_size, D].
+
+    The device half of the paged cache: a global pool of fixed-size
+    pages shared by every slot. Which slot owns which page lives
+    host-side (``PageAllocator`` + the engine's page tables) and reaches
+    the device as DATA — page-table contents are ints, never shapes, so
+    the jitted steps compile once regardless of admissions, prefix hits,
+    quarantine clears, and frees.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+
+def paged_kv_cache_shape(cfg, num_pages: int, page_size: int
+                         ) -> Tuple[int, ...]:
+    """[L, n_pages, Hkv, page_size, D] for any config ``kv_cache_shape``
+    knows (page 0 is the reserved TRASH page — size the pool with it)."""
+    l, _, h, _, d = kv_cache_shape(cfg, 1, 1)
+    return (l, num_pages, h, page_size, d)
+
+
+def init_paged_kv_cache(
+    cfg,
+    num_pages: int,
+    page_size: int,
+    *,
+    dtype: Any = None,
+    sharding: Optional[Any] = None,
+) -> PagedKVCache:
+    """Zeroed page pool in the model's compute dtype; with ``sharding``
+    (a NamedSharding applied to both pools, or a PagedKVCache of them)
+    the pools are created directly on their shards."""
+    shape = paged_kv_cache_shape(cfg, num_pages, page_size)
+    dt = dtype or getattr(cfg, "dtype", jnp.bfloat16)
+    k = jnp.zeros(shape, dt)
+    v = jnp.zeros(shape, dt)
+    if sharding is not None:
+        sk, sv = (sharding.k, sharding.v) \
+            if isinstance(sharding, PagedKVCache) else (sharding, sharding)
+        k = jax.device_put(k, sk)
+        v = jax.device_put(v, sv)
+    return PagedKVCache(k=k, v=v)
+
+
+def paged_kv_cache_specs(
+    *, tp_axis: Optional[str] = "tp"
+) -> PagedKVCache:
+    """PartitionSpec pair for the page pools — the same TP placement as
+    the dense ``kv_cache_specs``: KV heads over ``tp`` (matching the
+    column-parallel k/v projections). The page axis stays unsharded —
+    pages are the unit of host-side ownership and any page must be
+    reachable from any slot's table."""
+    spec = P(None, None, tp_axis, None, None)
+    return PagedKVCache(k=spec, v=spec)
+
+
+def paged_kv_cache_shardings(
+    mesh, *, tp_axis: Optional[str] = "tp"
+) -> PagedKVCache:
+    """NamedShardings over ``mesh`` for the page pools."""
+    specs = paged_kv_cache_specs(tp_axis=tp_axis)
+    return PagedKVCache(
+        k=NamedSharding(mesh, specs.k), v=NamedSharding(mesh, specs.v)
+    )
+
+
+class PageAllocator:
+    """Host-side page bookkeeping: free list + per-page refcounts.
+
+    Page ids are indices into the device pool; page ``TRASH_PAGE`` (0)
+    is reserved at construction and never handed out. A page is either
+    FREE (on the free list, refcount 0) or ALLOCATED (refcount >= 1):
+    ``alloc`` hands out pages at refcount 1, ``retain`` adds a
+    reference (a prefix-sharing slot, the radix tree), ``release``
+    drops one and returns the page to the free list at zero. Double
+    release and foreign retain raise — the conservation invariant
+    (free + allocated == capacity, every allocated page's refcount >= 1)
+    is property-tested across randomized admit/retire/quarantine
+    schedules.
+    """
+
+    def __init__(self, num_pages: int,
+                 reserved: Tuple[int, ...] = (TRASH_PAGE,)) -> None:
+        if num_pages < len(reserved) + 1:
+            raise ValueError(
+                f"page pool needs at least {len(reserved) + 1} pages "
+                f"({len(reserved)} reserved), got {num_pages}"
+            )
+        self.num_pages = num_pages
+        self.reserved = tuple(reserved)
+        self._free: deque[int] = deque(
+            p for p in range(num_pages) if p not in reserved)
+        self._ref: Dict[int, int] = {}
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable pages (pool minus reserved)."""
+        return self.num_pages - len(self.reserved)
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._ref)
+
+    def refcount(self, page: int) -> int:
+        """0 for free pages."""
+        return self._ref.get(page, 0)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` fresh pages at refcount 1, or None (allocation is
+        all-or-nothing — a partially admitted request would leak)."""
+        if n < 0:
+            raise ValueError(f"alloc needs n >= 0, got {n}")
+        if n > len(self._free):
+            return None
+        pages = [self._free.popleft() for _ in range(n)]
+        for p in pages:
+            self._ref[p] = 1
+        return pages
+
+    def retain(self, page: int) -> None:
+        if page not in self._ref:
+            raise ValueError(f"retain of unallocated page {page}")
+        self._ref[page] += 1
+
+    def release(self, page: int) -> None:
+        count = self._ref.get(page)
+        if count is None:
+            raise ValueError(f"double free of page {page}")
+        if count == 1:
+            del self._ref[page]
+            self._free.append(page)
+        else:
+            self._ref[page] = count - 1
+
+    def check_conservation(self) -> None:
+        """Raise unless free + allocated == capacity and every allocated
+        page holds a positive refcount (the property tests' oracle)."""
+        if len(self._free) + len(self._ref) != self.capacity:
+            raise AssertionError(
+                f"page leak: {len(self._free)} free + {len(self._ref)} "
+                f"allocated != capacity {self.capacity}"
+            )
+        bad = [p for p, c in self._ref.items() if c < 1]
+        if bad:
+            raise AssertionError(f"non-positive refcounts: {bad}")
+        overlap = set(self._free) & set(self._ref)
+        if overlap:
+            raise AssertionError(f"pages both free and allocated: {overlap}")
+
+
+class _RadixNode:
+    __slots__ = ("children", "page", "last_used")
+
+    def __init__(self, page: int = TRASH_PAGE) -> None:
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.page = page
+        self.last_used = 0
+
+
+class RadixPrefixCache:
+    """Page-granular radix tree over token prefixes.
+
+    Each edge is one full ``page_size`` token chunk; a node owns the pool
+    page holding that chunk's K/V. Prefix sharing is copy-on-write *at
+    the page boundary*: only FULLY-FROZEN prompt pages (every position
+    written at prefill, never written again) are ever registered, so a
+    shared page is immutable by construction — a partially-filled
+    boundary page is re-prefilled into the new request's own page
+    instead of being split.
+
+    The tree holds ONE allocator reference per registered page
+    (``retain`` at insert); slots sharing the page add their own. A node
+    is evictable only when no slot references its page (allocator
+    refcount back down to the tree's single reference) — eviction is
+    LRU over leaves, releasing the tree's reference so the page returns
+    to the free list at refcount 0.
+    """
+
+    def __init__(self, page_size: int,
+                 retain: Callable[[int], None],
+                 release: Callable[[int], None],
+                 refcount: Callable[[int], int]) -> None:
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self._retain = retain
+        self._release = release
+        self._refcount = refcount
+        self.root = _RadixNode()
+        self._clock = 0
+
+    def _chunks(self, tokens) -> List[Tuple[int, ...]]:
+        p = self.page_size
+        return [tuple(tokens[i:i + p])
+                for i in range(0, (len(tokens) // p) * p, p)]
+
+    def __len__(self) -> int:
+        count = 0
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            count += len(node.children)
+            stack.extend(node.children.values())
+        return count
+
+    def match(self, tokens) -> Tuple[int, List[int]]:
+        """Longest page-aligned cached prefix of ``tokens``:
+        (matched token count — a multiple of page_size — and the page
+        ids, root-first). Touches the matched path's LRU clocks. The
+        caller must ``retain`` every returned page before anything else
+        can evict."""
+        self._clock += 1
+        node = self.root
+        pages: List[int] = []
+        for chunk in self._chunks(tokens):
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            child.last_used = self._clock
+            pages.append(child.page)
+            node = child
+        return len(pages) * self.page_size, pages
+
+    def insert(self, tokens, pages: List[int]) -> int:
+        """Register ``tokens`` (length a multiple of page_size) held in
+        ``pages`` (one per chunk, root-first). Chunks already present
+        keep their existing page (first writer wins — concurrent
+        admissions of the same prompt each computed identical K/V, the
+        duplicate copy stays private to its slot); new nodes take one
+        allocator reference on their page. Returns the number of new
+        nodes."""
+        chunks = self._chunks(tokens)
+        if len(chunks) != len(pages) or len(tokens) % self.page_size:
+            raise ValueError(
+                f"insert needs page-aligned tokens and one page per "
+                f"chunk: {len(tokens)} tokens, {len(pages)} pages"
+            )
+        self._clock += 1
+        node = self.root
+        created = 0
+        for chunk, page in zip(chunks, pages):
+            child = node.children.get(chunk)
+            if child is None:
+                child = _RadixNode(page=page)
+                node.children[chunk] = child
+                self._retain(page)
+                created += 1
+            child.last_used = self._clock
+            node = child
+        return created
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pages by pruning LRU leaves whose page
+        no live slot references (allocator refcount == 1, the tree's
+        own). Returns how many were released. Inner nodes become
+        evictable once their children go — the loop re-scans until the
+        target is met or nothing more can move."""
+        freed = 0
+        while freed < n_pages:
+            leaves: List[Tuple[int, _RadixNode, Tuple[int, ...],
+                               _RadixNode]] = []
+            stack = [self.root]
+            while stack:
+                node = stack.pop()
+                for chunk, child in node.children.items():
+                    if child.children:
+                        stack.append(child)
+                    elif self._refcount(child.page) == 1:
+                        leaves.append((child.last_used, id(child), chunk,
+                                       node))
+                    # leaves with live slot references are pinned
+            if not leaves:
+                break
+            leaves.sort()
+            for _, _, chunk, parent in leaves:
+                child = parent.children.pop(chunk)
+                self._release(child.page)
+                freed += 1
+                if freed >= n_pages:
+                    break
+        return freed
+
+
+class PagedKVIO:
+    """Paged-cache adapter for the models' cache-aware forwards.
+
+    The dense path writes with ``write_kv_cache`` and attends with
+    ``cached_sdpa_attention`` against ``[B, Hkv, S_max, D]`` buffers;
+    with a ``kv_io`` the same forwards write/attend through this object
+    against the page pool — constructed INSIDE the jitted step from the
+    traced page tables, so tables are data and the step compiles once.
+    ``seq_limit`` crops the fallback's gathered view to the engine's
+    ``max_seq`` (bit-identical operand shapes vs the dense engine);
+    ``kernel`` forwards to ``paged_attention``'s dispatcher (None =
+    auto: Pallas decode kernel on TPU, lax gather elsewhere).
+    """
+
+    def __init__(self, page_tables: jax.Array, page_size: int, *,
+                 seq_limit: Optional[int] = None,
+                 kernel: Optional[bool] = None,
+                 interpret: bool = False) -> None:
+        self.page_tables = page_tables
+        self.page_size = page_size
+        self.seq_limit = seq_limit
+        self.kernel = kernel
+        self.interpret = interpret
+
+    def write(self, pool: jax.Array, new: jax.Array, positions: jax.Array,
+              write_mask: Optional[jax.Array]) -> jax.Array:
+        return paged_write_kv(pool, new, positions, self.page_tables,
+                              self.page_size, write_mask)
+
+    def attend(self, q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+               q_positions: jax.Array) -> jax.Array:
+        return paged_attention(
+            q, pool_k, pool_v, self.page_tables, q_positions,
+            page_size=self.page_size, seq_limit=self.seq_limit,
+            kernel=self.kernel, interpret=self.interpret,
+        )

@@ -232,12 +232,14 @@ def forward_cached(
     *,
     positions: jax.Array,
     write_mask: Optional[jax.Array] = None,
+    kv_io: Optional[Any] = None,
 ):
     """KV-cached forward: [B, S] tokens at absolute ``positions`` [B, S]
     -> (logits [B, S, V], new cache). Positional signal is the learned
     ``wpe`` table looked up at the absolute positions (no RoPE). Routing
     is deterministic (no noise) — matching ``generate``'s eval-mode
-    forward.
+    forward. ``kv_io`` swaps the cache layout (paged pool) exactly as in
+    ``llama.attention_block_cached``.
     """
     cache_k, cache_v = cache
     b, s = input_ids.shape
@@ -253,9 +255,14 @@ def forward_cached(
         def heads(t):
             return t.reshape(b, s, cfg.n_head, cfg.head_dim).transpose(0, 2, 1, 3)
 
-        ck = write_kv_cache(ck, heads(k), positions[:, 0], write_mask)
-        cv = write_kv_cache(cv, heads(v), positions[:, 0], write_mask)
-        o = cached_sdpa_attention(heads(q), ck, cv, positions)
+        if kv_io is None:
+            ck = write_kv_cache(ck, heads(k), positions[:, 0], write_mask)
+            cv = write_kv_cache(cv, heads(v), positions[:, 0], write_mask)
+            o = cached_sdpa_attention(heads(q), ck, cv, positions)
+        else:
+            ck = kv_io.write(ck, heads(k), positions, write_mask)
+            cv = kv_io.write(cv, heads(v), positions, write_mask)
+            o = kv_io.attend(heads(q), ck, cv, positions)
         o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.n_embd)
         h = h + o @ layer["attn_proj"].astype(cdt)
 

@@ -513,6 +513,7 @@ def attention_block_cached(
     cfg: LlamaConfig,
     *,
     write_mask: Optional[jax.Array] = None,
+    kv_io: Optional[Any] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Cache-aware pre-norm attention sub-block with residual.
 
@@ -524,6 +525,14 @@ def attention_block_cached(
     ``write_kv_cache``; ``write_mask`` [B] protects live slots during a
     mixed admit-prefill), and attention runs q-against-cache with the
     j <= p mask. Returns (out, new_cache_k, new_cache_v).
+
+    ``kv_io`` swaps the cache layout: an adapter with
+    ``write(cache, kv, positions, write_mask)`` and
+    ``attend(q, cache_k, cache_v, positions)`` (e.g. the paged pool's
+    ``inference.kv_cache.PagedKVIO``) replaces the dense
+    ``write_kv_cache`` + ``cached_sdpa_attention`` pair; the cache
+    arrays then carry the adapter's layout instead of
+    [B, Hkv, S_max, D].
     """
     cdt = cfg.dtype
     dh = cfg.actual_head_dim
@@ -539,9 +548,14 @@ def attention_block_cached(
     k = k.transpose(0, 2, 1, 3)
     v = v.transpose(0, 2, 1, 3)
     q, k = apply_rotary_pos_emb(q, k, cos, sin)
-    cache_k = write_kv_cache(cache_k, k, positions[:, 0], write_mask)
-    cache_v = write_kv_cache(cache_v, v, positions[:, 0], write_mask)
-    attn = cached_sdpa_attention(q, cache_k, cache_v, positions)
+    if kv_io is None:
+        cache_k = write_kv_cache(cache_k, k, positions[:, 0], write_mask)
+        cache_v = write_kv_cache(cache_v, v, positions[:, 0], write_mask)
+        attn = cached_sdpa_attention(q, cache_k, cache_v, positions)
+    else:
+        cache_k = kv_io.write(cache_k, k, positions, write_mask)
+        cache_v = kv_io.write(cache_v, v, positions, write_mask)
+        attn = kv_io.attend(q, cache_k, cache_v, positions)
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
     return x + attn @ layer["o_proj"].astype(cdt), cache_k, cache_v
 
@@ -564,6 +578,7 @@ def forward_cached(
     *,
     positions: jax.Array,
     write_mask: Optional[jax.Array] = None,
+    kv_io: Optional[Any] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """KV-cached decoder forward: [B, S] tokens at absolute ``positions``
     [B, S] -> (logits [B, S, V], new (cache_k, cache_v)).
@@ -575,7 +590,9 @@ def forward_cached(
     decode (S = 1, positions = current length per slot). The layer loop
     is the same ``lax.scan`` shape as the training forward — the cache
     rides the scan as per-layer xs/ys — so compile time stays O(1) in
-    depth.
+    depth. With ``kv_io`` the cache pair is the adapter's layout instead
+    (the paged pool's [L, n_pages, Hkv, page_size, D]); the scan slices
+    its leading layer axis the same way.
     """
     cache_k, cache_v = cache
     x = embed(params, input_ids, cfg)
@@ -588,7 +605,7 @@ def forward_cached(
         layer, ck, cv = xs
         h, ck, cv = attention_block_cached(
             h, layer, ck, cv, cos, sin, positions, cfg,
-            write_mask=write_mask,
+            write_mask=write_mask, kv_io=kv_io,
         )
         h = _mlp_block(h, layer, cfg)
         return h, (ck, cv)

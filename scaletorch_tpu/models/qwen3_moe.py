@@ -573,6 +573,7 @@ def forward_cached(
     *,
     positions: jax.Array,
     write_mask: Optional[jax.Array] = None,
+    kv_io: Optional[Any] = None,
 ):
     """KV-cached MoE decoder forward for the decode engine
     (inference/decode.py): [B, S] tokens at absolute ``positions`` [B, S]
@@ -608,7 +609,7 @@ def forward_cached(
         layer, ck, cv = xs
         h, ck, cv = _llama.attention_block_cached(
             h, layer, ck, cv, cos, sin, positions, cfg,
-            write_mask=write_mask,
+            write_mask=write_mask, kv_io=kv_io,
         )
         h, _aux, _stats = moe_block(h, layer, cfg, helpers)
         return h, (ck, cv)

@@ -1,1 +1,3 @@
-"""Pallas TPU kernels: flash attention (ops/pallas/flash.py)."""
+"""Pallas TPU kernels: flash attention (ops/pallas/flash.py), paged
+decode attention over the paged KV cache (ops/pallas/paged_attention.py),
+grouped expert MLP (ops/pallas/grouped_mlp.py)."""

@@ -31,8 +31,16 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
+
+# Guarded so `import scaletorch_tpu.ops` (and through it the inference
+# package, whose kv_cache pulls the paged-cache primitives) works on jax
+# builds whose pallas-TPU import fails; `masked_grouped_mlp` is the
+# non-TPU path and needs no pallas.
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover - exercised on pallas-less builds
+    pl = pltpu = None
 
 from scaletorch_tpu.models.layers import swiglu
 
@@ -284,6 +292,11 @@ def grouped_swiglu_mlp(x, counts, wg, wu, wd, bc=256, bi=512,
     """x: [E, G, C, H] capacity slots (prefix-filled per (e, g));
     counts: [E, G] int32 fill counts; wg/wu: [E, H, I]; wd: [E, I, H].
     Returns [E, G, C, H]; rows at or past the fill count are zero."""
+    if pl is None:
+        raise RuntimeError(
+            "the grouped-MLP kernel needs jax.experimental.pallas; this "
+            "jax build lacks it — use masked_grouped_mlp"
+        )
     bc = _pick_block(x.shape[2], bc)
     bi = _pick_block(wg.shape[-1], bi)
     return _forward(x, counts, wg, wu, wd, bc, bi, interpret)

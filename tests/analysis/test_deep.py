@@ -39,9 +39,9 @@ class TestManifestAuditsClean:
         _, reports = full_audit
         assert set(reports) == {
             "spmd_train_step", "declarative_train_step",
-            "prefill_step", "decode_step",
+            "prefill_step", "decode_step", "paged_decode_step",
         }
-        assert len(MANIFEST) == 4
+        assert len(MANIFEST) == 5
 
     def test_entries_filter_skips_unselected_builders(self):
         """A scoped run builds ONLY the selected entries (an unrelated

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """Decode microbenchmark: KV-cache engine vs the retired recompute loop.
 
-Two arms over the same tiny GPT model (CPU-friendly sizes, >= 512
-generated tokens — ISSUE 4 acceptance):
+Arms over tiny CPU-friendly models (>= 512 generated tokens for the
+cached-vs-recompute pair — ISSUE 4 acceptance):
 
   * ``recompute``: the original cache-less sampler
     (models/gpt_moe.generate_recompute) — a full O(S_max² · L) forward
@@ -11,7 +11,19 @@ generated tokens — ISSUE 4 acceptance):
     O(S_max · L) per token against the cache;
   * ``engine``: the same generation through the continuous-batching
     InferenceEngine on a Llama config (prefill + per-step jitted decode
-    with host-side slot bookkeeping — the serving-loop overhead arm).
+    with host-side slot bookkeeping — the serving-loop overhead arm);
+  * ``paged vs dense`` (ISSUE 10): the paged-cache engine against the
+    dense one on the same request schedule — tok/s, cache HBM bytes per
+    layout (``kv_cache_bytes``), and the max admissible concurrency at
+    EQUAL cache HBM: the dense layout admits ``B`` requests whatever
+    their length; a pool of the same bytes admits
+    ``capacity // pages_per_request`` — attested by actually admitting
+    them into a paged engine, not just arithmetic.
+
+Startup runs the PR 5 phase-0 gate (bench.py): a dead relay tunnel or a
+cpu-pinned JAX_PLATFORMS pins this process to the CPU backend BEFORE
+jax initializes, so the bench can never wedge CI rediscovering a dead
+TPU the way the r03-r05 rows did.
 
 Writes JSON under results/ (gitignored) and prints a table.
 
@@ -22,6 +34,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
 import os
 import sys
@@ -29,6 +42,31 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+
+
+def phase0_gate() -> str | None:
+    """PR 5 phase-0 fallback decision, BEFORE any jax import: reuse
+    bench.py's `_cpu_fallback_reason` (BENCH_FORCE_CPU override, dead-
+    relay probe, cpu-pinned platform list) and, when it abstains, the
+    bounded backend probe child. A non-None reason pins this process to
+    the CPU backend with pallas disabled — the same env the bench
+    orchestrator's CPU child runs under."""
+    spec = importlib.util.spec_from_file_location(
+        "_bench_gate", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    reason = bench._cpu_fallback_reason()
+    already_cpu = "cpu" in os.environ.get("JAX_PLATFORMS", "").lower()
+    if (reason is None and not already_cpu
+            and os.environ.get("BENCH_FORCE_CPU", "") != "0"):
+        reason = bench._probe_says_no_tpu()
+    if reason is not None:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["PALLAS_AXON_POOL_IPS"] = ""
+        os.environ["SCALETORCH_TPU_DISABLE_PALLAS"] = "1"
+        print(json.dumps({"event": "cpu_fallback", "reason": reason}),
+              file=sys.stderr, flush=True)
+    return reason
 
 
 def _time_tokens(fn, n_tokens: int, repeats: int = 1):
@@ -51,9 +89,13 @@ def main() -> None:
     ap.add_argument("--layers", type=int, default=2)
     ap.add_argument("--embd", type=int, default=64)
     ap.add_argument("--repeats", type=int, default=1)
+    ap.add_argument("--page_size", type=int, default=16,
+                    help="paged-cache page size for the paged-vs-dense row")
     ap.add_argument("--out", default=os.path.join(REPO, "results",
                                                   "bench_decode.json"))
     args = ap.parse_args()
+
+    fallback_reason = phase0_gate()
 
     import jax
     import jax.numpy as jnp
@@ -124,6 +166,85 @@ def main() -> None:
     print(f"\n  cached vs recompute speedup: {speedup:.2f}x  "
           f"(greedy outputs identical: {same})")
 
+    # ---- paged vs dense row (ISSUE 10) ---------------------------------
+    from scaletorch_tpu.inference.kv_cache import ceil_div, kv_cache_bytes
+
+    ps = args.page_size
+    dense_slots, s_max = 2, 256
+    # 64-token requests, but keep at least one generated token so a big
+    # --prompt can't degenerate the row into zero-token requests (which
+    # would zero row_tokens and spuriously trip the >= 2x gate below)
+    req_prompt = args.prompt
+    req_new = max(64 - req_prompt, 1)
+    schedule = [(list(range(1, req_prompt + 1)), req_new),
+                ([5] * req_prompt, req_new)]
+
+    def build(layout, **kw):
+        return InferenceEngine(
+            lparams, lcfg, max_slots=dense_slots, max_seq=s_max,
+            prefill_len=req_prompt, cache_layout=layout,
+            sampling=SamplingParams(temperature=0.0), **kw)
+
+    def serve(e):
+        ids = [e.submit(p, max_new_tokens=n) for p, n in schedule]
+        res = e.run()
+        return [res[i].tokens for i in ids]
+
+    dense_eng = build("dense")
+    out_dense = serve(dense_eng)  # warmup/compile
+    t0 = time.perf_counter()
+    out_dense = serve(dense_eng)
+    dense_s = time.perf_counter() - t0
+    paged_eng = build("paged", page_size=ps)
+    out_paged = serve(paged_eng)
+    t0 = time.perf_counter()
+    out_paged = serve(paged_eng)
+    paged_s = time.perf_counter() - t0
+    row_tokens = sum(n for _, n in schedule)
+    paged_same = out_dense == out_paged
+
+    dense_bytes = kv_cache_bytes(lcfg, dense_slots, s_max, jnp.float32)
+    page_bytes = kv_cache_bytes(lcfg, 1, ps, jnp.float32, layout="paged",
+                                page_size=ps, num_pages=1)
+    pool_pages = dense_bytes // page_bytes       # equal-HBM pool size
+    pages_per_req = ceil_div(req_prompt + req_new, ps)
+    admissible_paged = max((pool_pages - 1) // pages_per_req, 0)  # - TRASH
+    if admissible_paged >= 1:
+        # attest: a pool of exactly that many pages really admits them
+        # all concurrently (page-budget admission, not slot arithmetic)
+        attest = InferenceEngine(
+            lparams, lcfg, max_slots=admissible_paged, max_seq=s_max,
+            prefill_len=req_prompt, cache_layout="paged", page_size=ps,
+            num_pages=pool_pages, prefix_cache=False,
+            sampling=SamplingParams(temperature=0.0))
+        for k in range(admissible_paged):
+            attest.submit([k + 1] * req_prompt, max_new_tokens=req_new)
+        attest.step()
+        # everything admitted within the single step was resident at
+        # once — counted at admission, not after it, so one-token
+        # requests that retire inside the step still attest their
+        # concurrency
+        concurrent = attest.metrics.requests_admitted
+    else:
+        # degenerate sweep geometry (page_size ~ the whole dense cache):
+        # an equal-HBM pool can't hold even one request, nothing to
+        # attest — report 0 and let the warn-only gate handle the ratio
+        concurrent = 0
+    paged_pool_bytes = kv_cache_bytes(
+        lcfg, dense_slots, s_max, jnp.float32, layout="paged",
+        page_size=ps, num_pages=pool_pages)
+    ratio = concurrent / dense_slots
+
+    print(f"\n  paged vs dense (B={dense_slots}, S_max={s_max}, "
+          f"page={ps}, req={req_prompt + req_new} tokens):")
+    print(f"    dense : {row_tokens / dense_s:10.1f} tok/s  "
+          f"cache {dense_bytes / 2**20:.2f} MiB  "
+          f"max concurrent {dense_slots}")
+    print(f"    paged : {row_tokens / paged_s:10.1f} tok/s  "
+          f"pool  {paged_pool_bytes / 2**20:.2f} MiB  "
+          f"max concurrent {concurrent} at equal HBM "
+          f"({ratio:.1f}x, greedy identical: {paged_same})")
+
     result = {
         "config": {"block_size": block, "layers": args.layers,
                    "embd": args.embd, "tokens": args.tokens,
@@ -133,6 +254,19 @@ def main() -> None:
         "engine_tokens_per_s": engine_tps,
         "speedup_cached_vs_recompute": speedup,
         "greedy_outputs_identical": same,
+        "paged_vs_dense": {
+            "page_size": ps,
+            "request_tokens": req_prompt + req_new,
+            "dense_tokens_per_s": row_tokens / dense_s,
+            "paged_tokens_per_s": row_tokens / paged_s,
+            "dense_cache_bytes": dense_bytes,
+            "paged_pool_bytes_at_equal_hbm": paged_pool_bytes,
+            "max_concurrent_dense": dense_slots,
+            "max_concurrent_paged_at_equal_hbm": concurrent,
+            "concurrency_ratio": ratio,
+            "greedy_outputs_identical": paged_same,
+        },
+        "cpu_fallback_reason": fallback_reason,
         "backend": jax.default_backend(),
     }
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
@@ -142,6 +276,19 @@ def main() -> None:
     if speedup <= 1.0:
         print("  WARNING: cached decode did not beat recompute", file=sys.stderr)
         sys.exit(1)
+    if not paged_same:
+        print("  WARNING: paged greedy outputs diverged from dense",
+              file=sys.stderr)
+        sys.exit(1)
+    if ratio < 2.0:
+        print(f"  WARNING: paged concurrency gain {ratio:.1f}x < 2x at "
+              "equal HBM", file=sys.stderr)
+        # the >= 2x acceptance gate is defined on the default request
+        # geometry; exploratory --prompt/--page_size sweeps legitimately
+        # land below it (e.g. page_size ~ request length) and only warn
+        if (args.prompt == ap.get_default("prompt")
+                and args.page_size == ap.get_default("page_size")):
+            sys.exit(1)
 
 
 if __name__ == "__main__":
