@@ -669,6 +669,34 @@ class ResilienceArguments:
                           "admission and mid-decode. Env override: "
                           "SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP."},
     )
+    # Gateway fault injection (serving/gateway.py; the counting unit is
+    # 1-based HTTP requests, not decode steps)
+    ft_gw_tenant_storm_at: int = field(
+        default=0,
+        metadata={"help": "Gateway drill: when the k-th generate request "
+                          "arrives (0 = off; fires once), one synthetic "
+                          "'storm' tenant floods the admission queue with "
+                          "ft_gw_tenant_storm_count requests — drives "
+                          "weighted-fair queueing and shed-before-latency "
+                          "backpressure (429 + Retry-After). Env override: "
+                          "SCALETORCH_TPU_FT_GW_TENANT_STORM_AT."},
+    )
+    ft_gw_tenant_storm_count: int = field(
+        default=8,
+        metadata={"help": "Number of requests the gateway tenant-storm "
+                          "drill injects. Env override: "
+                          "SCALETORCH_TPU_FT_GW_TENANT_STORM_COUNT."},
+    )
+    ft_gw_replica_down_at: int = field(
+        default=0,
+        metadata={"help": "Gateway drill: when the k-th request is "
+                          "dispatched to a replica (0 = off; fires once), "
+                          "the router marks that replica dead mid-stream "
+                          "— its in-flight requests end 'aborted', queued "
+                          "requests re-route to the survivors. Env "
+                          "override: "
+                          "SCALETORCH_TPU_FT_GW_REPLICA_DOWN_AT."},
+    )
 
     def __post_init__(self) -> None:
         if self.divergence_policy not in ("skip", "rollback", "abort"):
@@ -699,7 +727,8 @@ class ResilienceArguments:
                      "ft_serve_nan_at_step",
                      "ft_serve_nan_slot", "ft_serve_slow_at_step",
                      "ft_serve_submit_storm_at_step",
-                     "ft_serve_deadline_storm_at_step"):
+                     "ft_serve_deadline_storm_at_step",
+                     "ft_gw_tenant_storm_at", "ft_gw_replica_down_at"):
             if getattr(self, name) < 0:
                 raise ValueError(
                     f"{name} must be >= 0, got {getattr(self, name)}")
@@ -732,6 +761,99 @@ class ResilienceArguments:
                 f"ft_serve_submit_storm_count must be >= 1, "
                 f"got {self.ft_serve_submit_storm_count}"
             )
+        if self.ft_gw_tenant_storm_count < 1:
+            raise ValueError(
+                f"ft_gw_tenant_storm_count must be >= 1, "
+                f"got {self.ft_gw_tenant_storm_count}"
+            )
+
+
+@dataclass
+class ServingArguments:
+    """Serving-gateway knobs (scaletorch_tpu/serving/): the async HTTP
+    front door — bind address, tenant fairness/rate limits, admission
+    backpressure, and multi-replica routing. Consumed by
+    ``scripts/serve.py`` and ``serving.gateway.ServingGateway``."""
+
+    serve_host: str = field(
+        default="127.0.0.1",
+        metadata={"help": "Gateway bind address."},
+    )
+    serve_port: int = field(
+        default=8000,
+        metadata={"help": "Gateway bind port (0 = ephemeral; the chosen "
+                          "port is logged and exposed as gateway.port)."},
+    )
+    serve_tenants: str = field(
+        default="",
+        metadata={"help": "Tenant spec 'name:weight[:rate[:burst]],...' — "
+                          "WFQ weight plus an optional token-bucket rate "
+                          "limit (request-cost units/s) and burst. Unknown "
+                          "tenants get weight serve_default_weight and no "
+                          "rate limit. Example: "
+                          "'free:1:100:200,pro:4,batch:0.5'."},
+    )
+    serve_default_weight: float = field(
+        default=1.0,
+        metadata={"help": "WFQ weight for tenants not named in "
+                          "serve_tenants."},
+    )
+    serve_max_backlog: int = field(
+        default=256,
+        metadata={"help": "Gateway admission backlog bound (all tenants). "
+                          "Beyond it new arrivals are shed (HTTP 429 with "
+                          "Retry-After) — backpressure degrades to "
+                          "shedding before it degrades to latency."},
+    )
+    serve_free_page_watermark: float = field(
+        default=0.05,
+        metadata={"help": "Paged engines only: when the page pool's free "
+                          "fraction sits below this watermark AND the "
+                          "gateway backlog is non-empty, new arrivals are "
+                          "shed instead of queued (the pool gauge drives "
+                          "admission, not wishful queueing)."},
+    )
+    serve_default_ttl_s: float = field(
+        default=0.0,
+        metadata={"help": "Deadline applied to requests that carry no "
+                          "ttl_s of their own (0 = none). Expired "
+                          "requests end 'timeout' (HTTP 504)."},
+    )
+    serve_replicas: int = field(
+        default=1,
+        metadata={"help": "In-process engine replicas behind the "
+                          "prefix-aware router (scripts/serve.py)."},
+    )
+
+    def __post_init__(self) -> None:
+        if self.serve_port < 0:
+            raise ValueError(
+                f"serve_port must be >= 0, got {self.serve_port}")
+        if self.serve_default_weight <= 0:
+            raise ValueError(
+                f"serve_default_weight must be > 0, "
+                f"got {self.serve_default_weight}")
+        if self.serve_max_backlog < 1:
+            raise ValueError(
+                f"serve_max_backlog must be >= 1, "
+                f"got {self.serve_max_backlog}")
+        if not 0.0 <= self.serve_free_page_watermark < 1.0:
+            raise ValueError(
+                f"serve_free_page_watermark must be in [0, 1), "
+                f"got {self.serve_free_page_watermark}")
+        if self.serve_default_ttl_s < 0:
+            raise ValueError(
+                f"serve_default_ttl_s must be >= 0, "
+                f"got {self.serve_default_ttl_s}")
+        if self.serve_replicas < 1:
+            raise ValueError(
+                f"serve_replicas must be >= 1, got {self.serve_replicas}")
+        if self.serve_tenants:
+            # delegate the spec grammar to its single home so the CLI
+            # fails at parse time, not mid-serve
+            from scaletorch_tpu.serving.admission import parse_tenant_spec
+
+            parse_tenant_spec(self.serve_tenants)
 
 
 @dataclass
@@ -900,6 +1022,7 @@ class ScaleTorchTPUArguments(
     TrainingArguments,
     CheckpointArguments,
     ResilienceArguments,
+    ServingArguments,
     TelemetryArguments,
     LoggingArguments,
 ):
@@ -910,6 +1033,7 @@ class ScaleTorchTPUArguments(
         DistributedArguments.__post_init__(self)
         CheckpointArguments.__post_init__(self)
         ResilienceArguments.__post_init__(self)
+        ServingArguments.__post_init__(self)
         TelemetryArguments.__post_init__(self)
         if self.log_format not in ("text", "json"):
             raise ValueError(

@@ -122,6 +122,12 @@ register_env("SCALETORCH_TPU_FT_SERVE_SLOW_SECONDS", "30", float)
 register_env("SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_SERVE_SUBMIT_STORM_COUNT", "8", int)
 register_env("SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP", "0", int)
+# Gateway fault injection (serving/gateway.py, same present-wins contract
+# over the ft_gw_* config fields; the counting unit is 1-based HTTP
+# requests — tenant storm at arrival k, replica-down at dispatch k).
+register_env("SCALETORCH_TPU_FT_GW_TENANT_STORM_AT", "0", int)
+register_env("SCALETORCH_TPU_FT_GW_TENANT_STORM_COUNT", "8", int)
+register_env("SCALETORCH_TPU_FT_GW_REPLICA_DOWN_AT", "0", int)
 # Telemetry (scaletorch_tpu/telemetry/): present-wins over the config
 # fields (an explicitly EMPTY dir cancels a config-armed telemetry run).
 register_env("SCALETORCH_TPU_TELEMETRY_DIR", "", str)

@@ -178,6 +178,7 @@ class EngineMetrics:
             "prefill_calls": self.prefill_calls,
             "decode_steps": self.decode_steps,
             "queue_depth": self.queue_depth,
+            "num_slots": self.num_slots,
             "slot_occupancy": (
                 self.active_slots / self.num_slots if self.num_slots else 0.0
             ),
@@ -287,6 +288,16 @@ class InferenceEngine:
     watchdog : optional ``HangWatchdog`` (see ``make_serving_watchdog``);
         ``step()`` beats it so a stalled tick fires the serving
         crash-report path.
+    on_tokens : optional ``(slot, request_id, token_ids)`` callback
+        invoked from ``step()`` with each slot's newly sampled tokens
+        the moment they exist on the host — PUSH, not poll, so a
+        streaming bridge (serving/gateway.py) never waits on terminal
+        results to forward tokens. Host-side only: the hook sees tokens
+        after the device->host transfer the engine already performs, so
+        attaching it adds zero retraces (``decode_compile_count`` stays
+        1). Concatenating every ``token_ids`` delivered for a request
+        reproduces its final ``RequestResult.tokens`` bit-exactly. A
+        raising hook is logged and disarmed, never fatal to serving.
     """
 
     def __init__(
@@ -318,6 +329,7 @@ class InferenceEngine:
         injector: Optional[ServingFaultInjector] = None,
         preemption: Any = None,
         watchdog: Any = None,
+        on_tokens: Optional[Callable[[int, int, List[int]], None]] = None,
     ) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
@@ -353,6 +365,7 @@ class InferenceEngine:
         self.injector = injector
         self.preemption = preemption
         self.watchdog = watchdog
+        self.on_tokens = on_tokens
 
         if cache_layout not in ("dense", "paged"):
             raise ValueError(
@@ -911,6 +924,18 @@ class InferenceEngine:
         if slot.first_token_t is None:
             slot.first_token_t = now
             self.metrics.record_ttft(now - req.submit_time)
+        if self.on_tokens is not None:
+            # push the newly sampled token to the streaming bridge BEFORE
+            # any stop condition retires the slot — the stream sees every
+            # token, then the terminal result. A raising hook is disarmed
+            # (logged), never fatal: one bad consumer must not take the
+            # whole decode batch down.
+            try:
+                self.on_tokens(i, req.request_id, [token])
+            except Exception:
+                logger.exception(
+                    "on_tokens hook raised; disarming the hook")
+                self.on_tokens = None
 
         reason = None
         if req.eos_id is not None and token == req.eos_id:
@@ -928,10 +953,13 @@ class InferenceEngine:
         """One engine tick: deadline sweep, admit into freed slots
         (prefill), then one decode step for the active slots — with the
         slots whose logits went non-finite quarantined instead of
-        emitting. Returns results that reached their terminal outcome
-        this tick. With a tracer attached the tick records ``tick`` /
-        ``admission`` / ``prefill`` / ``decode`` spans."""
-        self._finished_tick.clear()
+        emitting. Returns every result that reached its terminal outcome
+        since the PREVIOUS ``step()`` returned — including requests
+        finalized between ticks (a ``shed``/``rejected`` recorded inside
+        ``submit()``, a ``cancel()``), so a push-delivery bridge sees
+        each terminal result exactly once. With a tracer attached the
+        tick records ``tick`` / ``admission`` / ``prefill`` / ``decode``
+        spans."""
         tick = self.metrics.decode_steps + 1  # the decode step this tick runs
         if self.watchdog is not None:
             self.watchdog.beat(step=self.metrics.decode_steps,
@@ -1010,9 +1038,49 @@ class InferenceEngine:
         finished, self._finished_tick = self._finished_tick, []
         return finished
 
+    def tick(self) -> List[RequestResult]:
+        """Single-step driving alias for ``step()`` — the vocabulary the
+        serving bridge (serving/gateway.py) uses: one tick = one
+        admission sweep + one decode step."""
+        return self.step()
+
     @property
     def pending(self) -> int:
         return len(self._queue) + sum(s.active for s in self._slots)
+
+    def cancel(self, request_id: int, *,
+               detail: str = "cancelled by client") -> bool:
+        """Abort one in-flight request — queued or mid-decode — with an
+        ``aborted`` terminal result (partial tokens attached, pages
+        released through the allocator). The serving gateway calls this
+        when a client disconnects mid-stream: the slot frees for the
+        next admission instead of decoding for a closed socket. Returns
+        False when the id is unknown or already terminal."""
+        now = time.monotonic()
+        for idx, req in enumerate(self._queue):
+            if req.request_id == request_id:
+                del self._queue[idx]
+                self._finalize(req, "aborted", tokens=[], detail=detail,
+                               now=now)
+                self.metrics.queue_depth = len(self._queue)
+                return True
+        for i, slot in enumerate(self._slots):
+            if slot.active and slot.request.request_id == request_id:
+                self._retire_slot(i, "aborted", detail=detail, now=now)
+                self.metrics.active_slots = sum(
+                    s.active for s in self._slots)
+                return True
+        return False
+
+    def stop_admissions(self) -> None:
+        """Enter the draining state WITHOUT running the tick loop:
+        ``submit()`` now raises ``EngineDraining`` / rejects, while
+        queued and admitted requests keep flowing through ``step()``.
+        The blocking ``drain()`` composes this with its own loop; a
+        streaming bridge that owns the tick loop (and must keep
+        delivering per-tick tokens/results during shutdown) calls this
+        and keeps ticking until ``pending`` reaches zero. Idempotent."""
+        self._draining = True
 
     def _abort_pending(self, detail: str) -> None:
         """Terminal-result every in-flight request as ``aborted``
@@ -1075,7 +1143,7 @@ class InferenceEngine:
         ``finish_queued`` — a SIGTERM grace period has no room for
         unbounded queue depth. Anything still unfinished after
         ``max_steps`` is ``aborted`` with partials attached. Idempotent."""
-        self._draining = True
+        self.stop_admissions()
         if not finish_queued:
             now = time.monotonic()
             while self._queue:

@@ -104,12 +104,28 @@ class ServingFaultInjector:
       deadline (queued and mid-decode) into the past at step k, driving
       the ``timeout`` paths at admission and decode.
 
+    Gateway drills (serving/gateway.py; the counting unit is HTTP
+    requests, 1-based, not decode steps — the gateway is upstream of
+    the engine's tick clock):
+
+    * ``gw_tenant_storm_at`` / ``gw_tenant_storm_count`` — when the k-th
+      generate request arrives, one synthetic tenant (``storm``) floods
+      the admission queue with n requests, driving weighted-fair
+      queueing (the victim tenants keep their WFQ share) and
+      shed-before-latency backpressure (HTTP 429 + Retry-After).
+    * ``gw_replica_down_at`` — when the k-th request is DISPATCHED to a
+      replica, the router marks that replica dead mid-stream (exit-code
+      contract, as if it exited 43/44): its in-flight requests end
+      ``aborted``, queued requests re-route to the surviving replicas.
+
     Env overrides (present-wins, the ``env.env_override`` contract
     shared with the training ``FaultInjector``):
     ``SCALETORCH_TPU_FT_SERVE_NAN_STEP``, ``.._SERVE_NAN_SLOT``,
     ``.._SERVE_SLOW_STEP``, ``.._SERVE_SLOW_SECONDS``,
     ``.._SERVE_SUBMIT_STORM_STEP``, ``.._SERVE_SUBMIT_STORM_COUNT``,
-    ``.._SERVE_DEADLINE_STORM_STEP``.
+    ``.._SERVE_DEADLINE_STORM_STEP``; gateway:
+    ``SCALETORCH_TPU_FT_GW_TENANT_STORM_AT``,
+    ``.._GW_TENANT_STORM_COUNT``, ``.._GW_REPLICA_DOWN_AT``.
     """
 
     nan_logits_at_step: int = 0
@@ -119,10 +135,15 @@ class ServingFaultInjector:
     submit_storm_at_step: int = 0
     submit_storm_count: int = 8
     deadline_storm_at_step: int = 0
+    gw_tenant_storm_at: int = 0
+    gw_tenant_storm_count: int = 8
+    gw_replica_down_at: int = 0
     _nan_fired: bool = field(default=False, repr=False)
     _slow_fired: bool = field(default=False, repr=False)
     _storm_fired: bool = field(default=False, repr=False)
     _deadline_fired: bool = field(default=False, repr=False)
+    _gw_storm_fired: bool = field(default=False, repr=False)
+    _gw_down_fired: bool = field(default=False, repr=False)
 
     @classmethod
     def from_config(cls, cfg) -> "ServingFaultInjector":
@@ -153,13 +174,24 @@ class ServingFaultInjector:
             deadline_storm_at_step=int(env_or(
                 "SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP",
                 "ft_serve_deadline_storm_at_step", 0)),
+            gw_tenant_storm_at=int(env_or(
+                "SCALETORCH_TPU_FT_GW_TENANT_STORM_AT",
+                "ft_gw_tenant_storm_at", 0)),
+            gw_tenant_storm_count=int(env_or(
+                "SCALETORCH_TPU_FT_GW_TENANT_STORM_COUNT",
+                "ft_gw_tenant_storm_count", 8)),
+            gw_replica_down_at=int(env_or(
+                "SCALETORCH_TPU_FT_GW_REPLICA_DOWN_AT",
+                "ft_gw_replica_down_at", 0)),
         )
 
     @property
     def active(self) -> bool:
         return bool(self.nan_logits_at_step or self.slow_decode_at_step
                     or self.submit_storm_at_step
-                    or self.deadline_storm_at_step)
+                    or self.deadline_storm_at_step
+                    or self.gw_tenant_storm_at
+                    or self.gw_replica_down_at)
 
     def take_nan_logits(self, step: int) -> Optional[int]:
         """Slot index to poison before decode step ``step``, or None."""
@@ -206,6 +238,35 @@ class ServingFaultInjector:
             get_logger().warning(
                 f"serving fault injection: deadline storm at decode "
                 f"step {step}"
+            )
+            return True
+        return False
+
+    def take_gw_tenant_storm(self, http_request: int) -> int:
+        """Number of storm-tenant requests the gateway must inject when
+        the ``http_request``-th (1-based) generate request arrives."""
+        if self.gw_tenant_storm_at \
+                and http_request == self.gw_tenant_storm_at \
+                and not self._gw_storm_fired:
+            self._gw_storm_fired = True
+            get_logger().warning(
+                f"gateway fault injection: tenant storm of "
+                f"{self.gw_tenant_storm_count} requests at HTTP request "
+                f"{http_request}"
+            )
+            return max(0, self.gw_tenant_storm_count)
+        return 0
+
+    def take_gw_replica_down(self, dispatch: int) -> bool:
+        """True when the replica receiving the ``dispatch``-th (1-based)
+        routed request must be marked dead mid-stream."""
+        if self.gw_replica_down_at \
+                and dispatch == self.gw_replica_down_at \
+                and not self._gw_down_fired:
+            self._gw_down_fired = True
+            get_logger().warning(
+                f"gateway fault injection: marking the routed replica "
+                f"dead at dispatch {dispatch}"
             )
             return True
         return False

@@ -1,0 +1,75 @@
+"""The serving front door: async HTTP gateway over the inference engine.
+
+The step from "engine" to "service" (ROADMAP): PR 10 built the paged
+engine with radix prefix sharing, PR 7 its outcome taxonomy and drain
+discipline, PR 8 the metrics surfaces — this package is how a client
+reaches all of it over a socket:
+
+  * ``protocol``  — the versioned wire schema: ``POST /v1/generate``
+    bodies, SSE ``token``/``done`` framing, and the single
+    outcome -> HTTP-status mapping that extends PR 7's conservation
+    invariant to the wire.
+  * ``admission`` — tenant-fair admission: weighted fair queueing over
+    tenants (token-cost SFQ — a flooding tenant cannot starve the
+    rest), per-tenant token buckets, and shed-before-latency
+    backpressure driven by the engine's live page-pool gauges.
+  * ``router``    — prefix-cache-aware multi-replica routing: the radix
+    tree's page-aligned chunk hashes are the routing key, rendezvous
+    hashing covers cold prefixes, replica health rides the
+    0/42/43/44 exit-code contract.
+  * ``gateway``   — the stdlib-only asyncio HTTP/1.1 server with SSE
+    token streaming, the ``EngineWorker`` thread bridging the
+    synchronous engine (push-per-tick via the engine's ``on_tokens``
+    hook — zero retraces), ``/metrics`` (Prometheus, PR 8 renderer)
+    and ``/healthz``.
+
+Everything resolves LAZILY (PEP 562): ``protocol`` and ``admission``
+are pure stdlib, and clients that only talk the wire schema or
+validate a tenant spec (config.py's CLI parse, the smoke client) must
+not pay a jax import — only touching ``gateway``/``router`` symbols
+loads the engine side.
+
+``scripts/serve.py`` is the launcher; docs/serving_gateway.md the
+operator's guide.
+"""
+
+import importlib
+
+_EXPORTS = {
+    # admission (stdlib)
+    "AdmissionController": "admission",
+    "TenantConfig": "admission",
+    "TokenBucket": "admission",
+    "WeightedFairQueue": "admission",
+    "parse_tenant_spec": "admission",
+    # protocol (stdlib)
+    "PROTOCOL_VERSION": "protocol",
+    "STATUS_BY_OUTCOME": "protocol",
+    "GenerateRequest": "protocol",
+    "ProtocolError": "protocol",
+    "parse_generate_request": "protocol",
+    "parse_sse_stream": "protocol",
+    # router (pulls the framework logger)
+    "NoReplicaAvailable": "router",
+    "PrefixAwareRouter": "router",
+    "page_chunk_hashes": "router",
+    # gateway (pulls the engine, i.e. jax)
+    "EngineWorker": "gateway",
+    "GatewayMetrics": "gateway",
+    "ServingGateway": "gateway",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module_name = _EXPORTS.get(name)
+    if module_name is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    module = importlib.import_module(f"{__name__}.{module_name}")
+    return getattr(module, name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(_EXPORTS))

@@ -1,0 +1,1214 @@
+"""The front door: asyncio HTTP/1.1 gateway with SSE token streaming.
+
+Stdlib-only (asyncio + the repo's own modules — no web framework): the
+container bakes jax, not uvicorn, and a serving gateway whose transport
+layer is ~300 lines of readable asyncio is a gateway whose failure modes
+fit in one head.
+
+Three endpoints:
+
+  * ``POST /v1/generate`` — token-in/token-out generation. With
+    ``stream: true`` (default) the response is an SSE stream: one
+    ``token`` event per engine tick with that request's newly sampled
+    tokens, then exactly one ``done`` event carrying the PR 7 terminal
+    outcome. With ``stream: false`` a single JSON body whose HTTP
+    status IS the outcome (``protocol.STATUS_BY_OUTCOME``).
+  * ``GET /metrics`` — Prometheus text exposition via the PR 8
+    ``telemetry.export`` renderer: gateway gauges (per-tenant queue
+    depth, shed/429 counts, SSE streams open, router prefix-hit rate)
+    merged with each replica's live ``EngineMetrics`` snapshot
+    (prefixed ``replica_<id>_``).
+  * ``GET /healthz`` — liveness + capacity: per-replica alive flags and
+    the page-pool headroom gauges admission is actually steering by.
+
+The sync/async seam is ``EngineWorker``: the engine is synchronous and
+single-threaded by design (one jitted decode step, one compile), so each
+replica runs on its OWN worker thread driving ``engine.tick()``, and the
+event loop talks to it through a closure inbox. Tokens flow the other
+way by PUSH: the engine's per-tick ``on_tokens`` hook (never polling
+terminal results) hands each newly sampled token to the worker, which
+trampolines it onto the event loop with ``call_soon_threadsafe`` — the
+SSE write happens within one tick of the sample, and the bridge adds
+zero retraces (``decode_compile_count == 1`` with the gateway attached
+is acceptance-tested).
+
+Requests wait in the GATEWAY's weighted-fair queue (admission.py), not
+the engine's FIFO — the dispatcher only feeds a replica while its
+engine queue is shallow, so tenant fairness survives all the way to the
+decode batch. Multi-replica, the dispatcher routes prefix-aware
+(router.py): the radix tree's page-aligned chunk hashes are the routing
+key, so requests sharing a system prompt land on the replica whose tree
+already holds those pages.
+
+Every HTTP request ends in exactly one PR 7 outcome and exactly one
+terminal HTTP status/SSE ``done`` event — the engine's conservation
+invariant, extended to the wire and property-tested under tenant
+storms, deadline storms, and mid-stream disconnects (a dropped client
+aborts its request and releases its pages within a tick).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+from scaletorch_tpu.inference.engine import InferenceEngine, RequestResult
+from scaletorch_tpu.inference.resilience import (
+    TERMINAL_OUTCOMES,
+    ServingFaultInjector,
+)
+from scaletorch_tpu.serving import protocol
+from scaletorch_tpu.serving.admission import (
+    AdmissionController,
+    TenantConfig,
+)
+from scaletorch_tpu.serving.protocol import (
+    GenerateRequest,
+    ProtocolError,
+)
+from scaletorch_tpu.serving.router import (
+    NoReplicaAvailable,
+    PrefixAwareRouter,
+)
+from scaletorch_tpu.telemetry.export import render_prometheus
+from scaletorch_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+_REASONS = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    405: "Method Not Allowed", 413: "Payload Too Large",
+    429: "Too Many Requests", 500: "Internal Server Error",
+    503: "Service Unavailable", 504: "Gateway Timeout",
+}
+
+MAX_BODY_BYTES = 8 * 2**20
+MAX_HEADER_LINES = 100
+HEADER_TIMEOUT_S = 30.0
+
+
+# --------------------------------------------------------------------------
+# Engine worker: the sync engine on its own thread, push-streaming out
+# --------------------------------------------------------------------------
+
+
+class _Handlers:
+    __slots__ = ("on_tokens", "on_done")
+
+    def __init__(self, on_tokens: Callable[[List[int]], None],
+                 on_done: Callable[[RequestResult], None]) -> None:
+        self.on_tokens = on_tokens
+        self.on_done = on_done
+
+
+class EngineWorker:
+    """One engine replica on one worker thread.
+
+    The thread owns the engine exclusively: submits/cancels arrive as
+    closures on an inbox drained between ticks, generated tokens leave
+    through the engine's ``on_tokens`` hook, terminal results through
+    the per-tick finished list — push on every edge, no polling of
+    terminal state. ``tick_listeners`` fire after every tick (the
+    gateway uses one to wake its dispatcher); callbacks run ON THE
+    WORKER THREAD and must trampoline themselves onto the event loop.
+    """
+
+    def __init__(self, engine: InferenceEngine, *, replica_id: str = "r0",
+                 idle_wait_s: float = 0.01,
+                 max_drain_ticks: int = 100_000) -> None:
+        if engine.on_tokens is not None:
+            raise ValueError(
+                "engine already has an on_tokens hook; the worker owns it")
+        self.engine = engine
+        self.replica_id = replica_id
+        self.idle_wait_s = idle_wait_s
+        self.max_drain_ticks = max_drain_ticks
+        engine.on_tokens = self._hook_tokens
+        self._inbox: "queue.SimpleQueue[Callable[[], None]]" = \
+            queue.SimpleQueue()
+        self._handlers: Dict[int, _Handlers] = {}
+        self._reap_lock = threading.Lock()
+        self._stop = False
+        self.alive = False
+        self.exit_code: Optional[int] = None
+        self.tick_listeners: List[Callable[[], None]] = []
+        self._thread = threading.Thread(
+            target=self._loop, name=f"engine-worker-{replica_id}",
+            daemon=True)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "EngineWorker":
+        self.alive = True
+        self._thread.start()
+        return self
+
+    def shutdown(self, *, drain: bool = True) -> None:
+        """Stop the worker: admissions stop immediately; with ``drain``
+        the thread keeps ticking until in-flight requests finish (their
+        streams end normally), without it everything in flight is
+        aborted. Returns immediately — ``join()`` to wait."""
+
+        def _do() -> None:
+            self.engine.stop_admissions()
+            if not drain:
+                self._abort_inflight("gateway shutdown without drain")
+            self._stop = True
+
+        self._inbox.put(_do)
+
+    def fail(self, detail: str = "replica marked dead") -> None:
+        """Simulate/execute a replica death (the ``gw_replica_down``
+        drill and the router ejection path): every in-flight request
+        ends ``aborted`` with its partial tokens and pages released,
+        then the thread exits with the serving-stall exit code in
+        ``exit_code``."""
+
+        def _do() -> None:
+            self.engine.stop_admissions()
+            self._abort_inflight(detail)
+            self.exit_code = 44
+            self._stop = True
+
+        self._inbox.put(_do)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    # -- event-loop-side API ----------------------------------------------
+    def submit(self, req: GenerateRequest,
+               on_tokens: Callable[[int, List[int]], None],
+               on_done: Callable[[RequestResult], None],
+               *, ttl_s: Optional[float] = None,
+               on_submitted: Optional[Callable[[int], None]] = None,
+               ) -> None:
+        """Enqueue one request onto the worker (any thread). Callbacks
+        fire on the worker thread: ``on_submitted(request_id)`` once the
+        engine assigns an id, ``on_tokens(request_id, token_ids)`` per
+        tick with new tokens, and exactly one terminal ``on_done`` — a
+        submit the engine refuses becomes an ``on_done`` with a
+        ``rejected`` result."""
+
+        def _do() -> None:
+            try:
+                rid = self.engine.submit(
+                    req.prompt, max_new_tokens=req.max_new_tokens,
+                    eos_id=req.eos_id, seed=req.seed, ttl_s=ttl_s)
+            except Exception as exc:
+                on_done(RequestResult(
+                    request_id=-1, prompt=list(req.prompt), tokens=[],
+                    finish_reason="rejected", outcome="rejected",
+                    detail=str(exc)))
+                return
+            self._handlers[rid] = _Handlers(on_tokens, on_done)
+            if on_submitted is not None:
+                on_submitted(rid)
+            result = self.engine.result(rid)
+            if result is not None:
+                # terminal at submit (rejected under strict_submit=False)
+                self._deliver(result)
+
+        # enqueue FIRST, then re-check liveness: if the worker thread
+        # exited between the dispatcher's health check and this put, no
+        # thread will ever drain the inbox — reap it here so the closure
+        # still runs (the engine is stopped, so _do answers `rejected`)
+        # instead of stranding the client. The lock serializes this
+        # against the thread's own exit-time reap; SimpleQueue makes a
+        # doubly-drained inbox safe (each closure pops exactly once).
+        self._inbox.put(_do)
+        if not self.alive:
+            self._reap_stale()
+
+    def cancel(self, request_id: int, detail: str) -> None:
+        """Abort one request (client disconnected). The ``aborted``
+        terminal result is delivered through the normal path."""
+
+        def _do() -> None:
+            if self.engine.cancel(request_id, detail=detail):
+                result = self.engine.result(request_id)
+                if result is not None:
+                    self._deliver(result)
+
+        self._inbox.put(_do)
+
+    def gauges(self) -> Dict[str, float]:
+        """The live EngineMetrics snapshot (flat numeric reads — safe
+        cross-thread)."""
+        return self.engine.metrics.snapshot()
+
+    @property
+    def inflight(self) -> int:
+        return len(self._handlers)
+
+    # -- worker-thread internals ------------------------------------------
+    def _hook_tokens(self, slot: int, request_id: int,
+                     token_ids: List[int]) -> None:
+        handlers = self._handlers.get(request_id)
+        if handlers is not None:
+            handlers.on_tokens(request_id, list(token_ids))
+
+    def _deliver(self, result: RequestResult) -> None:
+        handlers = self._handlers.pop(result.request_id, None)
+        self.engine.pop_result(result.request_id)
+        if handlers is not None:
+            handlers.on_done(result)
+
+    def _abort_inflight(self, detail: str) -> None:
+        for rid in list(self._handlers):
+            if self.engine.cancel(rid, detail=detail):
+                result = self.engine.result(rid)
+                if result is not None:
+                    self._deliver(result)
+        # anything left (already terminal, delivery pending) flushes now
+        for rid in list(self._handlers):
+            result = self.engine.result(rid)
+            if result is not None:
+                self._deliver(result)
+
+    def _drain_inbox(self) -> None:
+        while True:
+            try:
+                fn = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            fn()
+
+    def _notify_tick(self) -> None:
+        for listener in self.tick_listeners:
+            try:
+                listener()
+            except Exception:
+                pass
+
+    def _loop(self) -> None:
+        engine = self.engine
+        drain_ticks = 0
+        try:
+            while True:
+                self._drain_inbox()
+                if self._stop:
+                    if not engine.pending:
+                        break
+                    drain_ticks += 1
+                    if drain_ticks > self.max_drain_ticks:
+                        self._abort_inflight("drain tick budget exhausted")
+                        break
+                if engine.pending:
+                    finished = engine.tick()
+                    for result in finished:
+                        self._deliver(result)
+                    self._notify_tick()
+                elif not self._stop:
+                    try:
+                        fn = self._inbox.get(timeout=self.idle_wait_s)
+                    except queue.Empty:
+                        continue
+                    fn()
+        except Exception:
+            logger.exception(
+                "engine worker %s crashed; aborting its in-flight "
+                "requests", self.replica_id)
+            self.exit_code = 44
+            try:
+                self._abort_inflight("replica crashed")
+            except Exception:
+                pass
+        finally:
+            self.alive = False
+            if self.exit_code is None:
+                self.exit_code = 0
+            self._reap_stale()
+            self._notify_tick()
+
+    def _reap_stale(self) -> None:
+        """Answer closures that raced into the inbox around the worker
+        thread's exit — a submit landing here becomes a ``rejected``
+        (the engine is stopped), never a hung client. Runs on the
+        worker thread at exit AND on any caller that enqueued into a
+        dead inbox; the lock serializes the two (the engine is no
+        longer ticking, so cross-thread engine access is safe)."""
+        with self._reap_lock:
+            try:
+                # idempotent; guarantees a stale submit is REJECTED
+                # rather than queued into an engine nobody ticks
+                self.engine.stop_admissions()
+                self._drain_inbox()
+                self._abort_inflight("replica exited")
+            except Exception:
+                pass
+
+
+# --------------------------------------------------------------------------
+# Gateway metrics
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class GatewayMetrics:
+    """HTTP-layer counters. The conservation invariant extends PR 7 to
+    the wire: once every connection has its terminal response,
+    ``http_requests_received == sum(outcomes.values())`` — checked by
+    ``check_conservation`` and property-tested. Drill-injected storm
+    requests are accounted separately (they are not HTTP requests).
+    ``responses_by_status`` records each request's TERMINAL status
+    (``STATUS_BY_OUTCOME``) — a stream that committed 200 and then
+    timed out counts under 504, the status its outcome maps to."""
+
+    http_requests_received: int = 0
+    responses_by_status: Dict[int, int] = field(default_factory=dict)
+    outcomes: Dict[str, int] = field(
+        default_factory=lambda: {o: 0 for o in TERMINAL_OUTCOMES})
+    sse_streams_open: int = 0
+    sse_streams_total: int = 0
+    injected_storm_requests: int = 0
+    storm_outcomes: Dict[str, int] = field(
+        default_factory=lambda: {o: 0 for o in TERMINAL_OUTCOMES})
+
+    def record_response(self, outcome: str, status: int) -> None:
+        self.outcomes[outcome] += 1
+        self.responses_by_status[status] = \
+            self.responses_by_status.get(status, 0) + 1
+
+    def check_conservation(self) -> None:
+        total = sum(self.outcomes.values())
+        if total != self.http_requests_received:
+            raise AssertionError(
+                f"HTTP outcome leak: {self.http_requests_received} "
+                f"received != {total} outcomes ({self.outcomes})")
+
+    def snapshot(self, *, tenant_depths: Dict[str, int],
+                 shed_count: int,
+                 router_snapshot: Dict[str, float]) -> Dict[str, float]:
+        snap: Dict[str, float] = {
+            "http_requests_received": self.http_requests_received,
+            "http_429_total": self.responses_by_status.get(429, 0),
+            "sse_streams_open": self.sse_streams_open,
+            "sse_streams_total": self.sse_streams_total,
+            "gateway_shed_total": shed_count,
+            "injected_storm_requests": self.injected_storm_requests,
+        }
+        for outcome, count in self.outcomes.items():
+            snap[f"http_{outcome}"] = count
+        for status, count in self.responses_by_status.items():
+            snap[f"http_status_{status}"] = count
+        for tenant, depth in tenant_depths.items():
+            snap[f"tenant_queue_depth_{tenant}"] = depth
+        snap.update(router_snapshot)
+        return snap
+
+
+# --------------------------------------------------------------------------
+# The gateway
+# --------------------------------------------------------------------------
+
+
+class _Pending:
+    """Event-loop-side state of one generate request."""
+
+    __slots__ = ("req", "chan", "request_id", "replica_id", "cancelled",
+                 "deadline", "synthetic")
+
+    def __init__(self, req: GenerateRequest, *,
+                 deadline: Optional[float],
+                 synthetic: bool = False) -> None:
+        self.req = req
+        self.chan: "asyncio.Queue[Tuple[str, Any]]" = asyncio.Queue()
+        self.request_id: Optional[int] = None
+        self.replica_id: Optional[str] = None
+        self.cancelled: Optional[str] = None  # outcome it was closed with
+        self.deadline = deadline
+        self.synthetic = synthetic
+
+
+class ServingGateway:
+    """Asyncio HTTP/1.1 + SSE front end over one or more engine workers.
+
+    Parameters
+    ----------
+    engines : one engine/worker, or ``{replica_id: engine-or-worker}``
+        for multi-replica serving. Plain engines are wrapped in
+        ``EngineWorker``s owned (started/joined) by the gateway.
+    router : optional ``PrefixAwareRouter`` (built over the replica ids
+        and the first engine's page size when absent).
+    tenants / default_weight / max_backlog / free_page_watermark :
+        admission knobs (admission.AdmissionController).
+    default_ttl_s : deadline for requests without their own ``ttl_s``
+        (0 = none). Queued past it -> 504 ``timeout``; dispatched past
+        it the ENGINE deadline fires (same outcome).
+    injector : optional ``ServingFaultInjector`` driving the gateway
+        drills (``gw_tenant_storm_*``, ``gw_replica_down_at``).
+    exporter : optional ``telemetry.TelemetryExporter``; the gateway
+        appends ``gateway_metrics`` JSONL records every
+        ``export_every`` terminal responses and at shutdown — the same
+        schema-versioned stream the trainer and engine write.
+    """
+
+    def __init__(
+        self,
+        engines: Union[InferenceEngine, EngineWorker,
+                       Dict[str, Union[InferenceEngine, EngineWorker]]],
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        router: Optional[PrefixAwareRouter] = None,
+        tenants: Optional[Dict[str, TenantConfig]] = None,
+        default_weight: float = 1.0,
+        max_backlog: int = 256,
+        free_page_watermark: float = 0.05,
+        default_ttl_s: float = 0.0,
+        injector: Optional[ServingFaultInjector] = None,
+        exporter: Any = None,
+        export_every: int = 32,
+    ) -> None:
+        if isinstance(engines, (InferenceEngine, EngineWorker)):
+            engines = {"r0": engines}
+        if not engines:
+            raise ValueError("gateway needs at least one engine")
+        self.workers: Dict[str, EngineWorker] = {}
+        self._owned_workers: List[EngineWorker] = []
+        for rid, eng in engines.items():
+            if isinstance(eng, EngineWorker):
+                self.workers[rid] = eng
+            else:
+                worker = EngineWorker(eng, replica_id=rid)
+                self.workers[rid] = worker
+                self._owned_workers.append(worker)
+        page_size = next(iter(self.workers.values())).engine.page_size
+        self.router = router or PrefixAwareRouter(
+            list(self.workers), page_size)
+        self.admission = AdmissionController(
+            gauges_fn=self._aggregate_gauges,
+            tenants=tenants,
+            default_weight=default_weight,
+            max_backlog=max_backlog,
+            free_page_watermark=free_page_watermark,
+            # full-backlog fairness eviction: the over-share tenant's
+            # oldest queued request answers 429 so an under-share
+            # arrival can enter the fair queue
+            on_shed=lambda pending, decision: self._finish_local(
+                pending, "shed", decision.reason),
+        )
+        self.metrics = GatewayMetrics()
+        self.default_ttl_s = default_ttl_s
+        self.injector = injector
+        self.exporter = exporter
+        self.export_every = export_every
+        self._responses_since_export = 0
+        self._host = host
+        self._requested_port = port
+        self.port: Optional[int] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._wake: Optional[asyncio.Event] = None
+        self._dispatch_task: Optional[asyncio.Task] = None
+        self._dispatch_count = 0
+        self._closing = False
+        self._open_generates = 0  # generate handlers awaiting a terminal
+        self._thread: Optional[threading.Thread] = None
+        self._thread_stopped = threading.Event()
+
+    # -- gauges ------------------------------------------------------------
+    def _aggregate_gauges(self) -> Dict[str, float]:
+        """The admission controller's view of the fleet: pool occupancy
+        summed over alive replicas (the shed watermark), engine queue
+        depth of the SHALLOWEST replica (dispatch headroom — any
+        replica able to take work means work can move)."""
+        agg = {"pages_in_use": 0.0, "page_pool_free": 0.0,
+               "queue_depth": float("inf"), "num_slots": 1.0}
+        saw = False
+        for worker in self.workers.values():
+            if not worker.alive:
+                continue
+            snap = worker.gauges()
+            saw = True
+            agg["pages_in_use"] += snap.get("pages_in_use", 0.0)
+            agg["page_pool_free"] += snap.get("page_pool_free", 0.0)
+            if snap.get("queue_depth", 0.0) < agg["queue_depth"]:
+                agg["queue_depth"] = snap.get("queue_depth", 0.0)
+                agg["num_slots"] = max(1.0, snap.get("num_slots", 1.0))
+        if not saw:
+            agg["queue_depth"] = float("inf")
+        return agg
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> "ServingGateway":
+        self._loop = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
+        loop = self._loop
+        wake = self._wake
+
+        def _on_tick() -> None:
+            try:
+                loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                pass  # loop already closed during shutdown
+
+        for worker in self.workers.values():
+            worker.tick_listeners.append(_on_tick)
+        for worker in self._owned_workers:
+            worker.start()
+        self._dispatch_task = asyncio.ensure_future(self._dispatch_loop())
+        self._server = await asyncio.start_server(
+            self._handle_connection, self._host, self._requested_port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info(
+            "serving gateway on http://%s:%d (replicas: %s)",
+            self._host, self.port, ", ".join(self.workers))
+        return self
+
+    async def serve_forever(self) -> None:
+        if self._server is None:
+            await self.start()
+        await self._server.serve_forever()
+
+    async def stop(self, *, drain: bool = True,
+                   timeout_s: float = 60.0) -> None:
+        """Graceful shutdown: stop accepting, abort the queued backlog
+        (PR 7 drain semantics: queued-but-never-dispatched ends
+        ``aborted``), drain the replicas (in-flight streams end
+        normally), flush the final metrics export."""
+        if self._closing:
+            return
+        self._closing = True
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        # queued-but-not-dispatched requests end aborted NOW — a
+        # SIGTERM grace period has no room for unbounded backlog
+        for _tenant, pending, _cost in self.admission.queue.drain_all():
+            self._finish_local(
+                pending, "aborted", "gateway draining: not yet dispatched")
+        for worker in self.workers.values():
+            if worker.alive:
+                worker.shutdown(drain=drain)
+        loop = asyncio.get_running_loop()
+        deadline = time.monotonic() + timeout_s
+        for worker in self.workers.values():
+            # join in the executor: the event loop must keep running so
+            # in-flight SSE handlers can flush the tokens/done events the
+            # draining workers are still pushing
+            await loop.run_in_executor(
+                None, worker.join, max(0.1, deadline - time.monotonic()))
+        if self._dispatch_task is not None:
+            self._wake.set()
+            self._dispatch_task.cancel()
+            try:
+                await self._dispatch_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        # let the in-flight handlers consume their terminal events and
+        # write their responses before the caller tears the loop down
+        flush_deadline = time.monotonic() + 10.0
+        while (self._open_generates > 0
+               and time.monotonic() < flush_deadline):
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0)
+        self._export(final=True)
+        logger.info("serving gateway stopped (drained=%s)", drain)
+
+    # -- sync harness (tests + scripts) -----------------------------------
+    def start_in_thread(self) -> "ServingGateway":
+        """Run the gateway on its own event-loop thread and return once
+        the port is bound — the harness tests and the smoke script use
+        this; production entry points drive ``start()`` directly."""
+        started = threading.Event()
+        error: List[BaseException] = []
+
+        def _run() -> None:
+            loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(loop)
+            try:
+                loop.run_until_complete(self.start())
+            except BaseException as exc:  # surface bind errors
+                error.append(exc)
+                started.set()
+                return
+            started.set()
+            try:
+                loop.run_forever()
+            finally:
+                loop.close()
+                self._thread_stopped.set()
+
+        self._thread = threading.Thread(
+            target=_run, name="serving-gateway", daemon=True)
+        self._thread.start()
+        started.wait(timeout=30.0)
+        if error:
+            raise error[0]
+        if self.port is None:
+            raise RuntimeError("gateway failed to start within 30s")
+        return self
+
+    def stop_sync(self, *, drain: bool = True,
+                  timeout_s: float = 60.0) -> None:
+        if self._thread is None or self._loop is None:
+            return
+        fut = asyncio.run_coroutine_threadsafe(
+            self.stop(drain=drain, timeout_s=timeout_s), self._loop)
+        fut.result(timeout=timeout_s + 10.0)
+        self._loop.call_soon_threadsafe(self._loop.stop)
+        self._thread_stopped.wait(timeout=10.0)
+        self._thread.join(timeout=10.0)
+
+    # -- dispatch ----------------------------------------------------------
+    async def _dispatch_loop(self) -> None:
+        while not self._closing:
+            await self._wake.wait()
+            self._wake.clear()
+            try:
+                self._dispatch_ready()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a dispatcher death would strand every queued client;
+                # log and keep pumping
+                logger.exception("dispatch iteration failed")
+
+    def _dispatch_ready(self) -> None:
+        """Pump the fair queue into the replicas until headroom runs
+        out (one wake's worth of work; synchronous, so it is atomic
+        w.r.t. the handlers sharing the event loop)."""
+        if not any(w.alive for w in self.workers.values()):
+            # fleet gone: nothing will ever tick again — answer the
+            # backlog instead of letting clients hang
+            for _t, pending, _c in self.admission.queue.drain_all():
+                self._finish_local(pending, "rejected",
+                                   "no healthy replica")
+            return
+        # replicas that can take one more submit RIGHT NOW; a request
+        # whose prefix-affine target is full is HELD (affinity beats a
+        # cold prefill elsewhere) but must not freeze dispatch to the
+        # other replicas — we keep scanning past it while any replica
+        # still has headroom. Submits from THIS pump are closures the
+        # worker has not executed yet, so the engine's queue gauge is
+        # stale by exactly `pumped[rid]` — count them ourselves or one
+        # pump could pour the whole backlog into a single replica.
+        pumped: Dict[str, int] = {rid: 0 for rid in self.workers}
+
+        def _room(rid: str, worker: EngineWorker) -> bool:
+            snap = worker.gauges()
+            return (snap.get("queue_depth", 0.0) + pumped[rid]
+                    < max(1.0, snap.get("num_slots", 1.0)))
+
+        open_replicas = {
+            rid for rid, w in self.workers.items()
+            if w.alive and _room(rid, w)}
+        held = []
+        try:
+            while open_replicas:
+                entry = self.admission.next_ready()
+                if entry is None:
+                    return
+                tenant, pending, cost = entry
+                now = time.monotonic()
+                if pending.cancelled is not None:
+                    continue  # its handler already answered (disconnect)
+                if pending.deadline is not None \
+                        and now >= pending.deadline:
+                    self._finish_local(
+                        pending, "timeout",
+                        "deadline exceeded in the gateway queue")
+                    continue
+                try:
+                    replica_id = self.router.route(pending.req.prompt)
+                except NoReplicaAvailable:
+                    self._finish_local(pending, "rejected",
+                                       "no healthy replica")
+                    continue
+                worker = self.workers[replica_id]
+                if not worker.alive:
+                    self.router.mark_dead(replica_id, worker.exit_code)
+                    self.admission.requeue(tenant, pending, cost)
+                    continue
+                if replica_id not in open_replicas:
+                    held.append(entry)
+                    continue
+                self._dispatch_count += 1
+                self._submit_to(worker, replica_id, pending)
+                pumped[replica_id] += 1
+                if not _room(replica_id, worker):
+                    open_replicas.discard(replica_id)
+                if self.injector is not None and \
+                        self.injector.take_gw_replica_down(
+                            self._dispatch_count):
+                    self.router.mark_dead(replica_id, 44)
+                    worker.fail()
+                    open_replicas.discard(replica_id)
+        finally:
+            # held requests go back to the FRONT of their tenant queues
+            # in reverse pop order — fair-queue positions preserved
+            for tenant, pending, cost in reversed(held):
+                self.admission.requeue(tenant, pending, cost)
+
+    def _submit_to(self, worker: EngineWorker, replica_id: str,
+                   pending: _Pending) -> None:
+        pending.replica_id = replica_id
+        loop = self._loop
+        chan = pending.chan
+
+        def _push(kind: str, payload: Any) -> None:
+            try:
+                loop.call_soon_threadsafe(chan.put_nowait, (kind, payload))
+            except RuntimeError:
+                pass  # loop closed: the client is gone anyway
+
+        # the request aged in the gateway queue; the engine deadline
+        # continues the ORIGINAL budget, not a fresh one
+        ttl = (max(0.001, pending.deadline - time.monotonic())
+               if pending.deadline is not None else None)
+        worker.submit(
+            pending.req,
+            lambda rid, toks: _push("tokens", (rid, toks)),
+            lambda result: _push("done", result),
+            ttl_s=ttl,
+            on_submitted=lambda rid: _push("submitted", rid),
+        )
+
+    # -- request bookkeeping ----------------------------------------------
+    def _finish_local(self, pending: _Pending, outcome: str,
+                      detail: str) -> None:
+        """Terminal a request that never reached an engine (gateway
+        queue timeout / drain / no replica); its handler answers with
+        the synthesized result."""
+        if pending.cancelled is not None:
+            return
+        pending.cancelled = outcome
+        pending.chan.put_nowait(("local", (outcome, detail)))
+
+    def _record_outcome(self, pending: _Pending, outcome: str,
+                        status: int) -> None:
+        if pending.synthetic:
+            self.metrics.storm_outcomes[outcome] += 1
+        else:
+            self.metrics.record_response(outcome, status)
+        self._responses_since_export += 1
+        if self.exporter is not None and \
+                self._responses_since_export >= self.export_every:
+            self._export()
+
+    def _export(self, final: bool = False) -> None:
+        if self.exporter is None:
+            return
+        self._responses_since_export = 0
+        try:
+            self.exporter.emit("gateway_metrics", self.snapshot())
+        except Exception:
+            logger.exception("gateway metrics export failed")
+
+    def snapshot(self) -> Dict[str, float]:
+        """The gateway's flat gauge/counter record — the
+        ``gateway_metrics`` JSONL kind and the /metrics exposition."""
+        return self.metrics.snapshot(
+            tenant_depths=self.admission.depths(),
+            shed_count=self.admission.shed_count,
+            router_snapshot=self.router.snapshot(),
+        )
+
+    # -- HTTP --------------------------------------------------------------
+    async def _handle_connection(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await self._read_request(reader)
+            if request is None:
+                return
+            method, path, headers, body = request
+            if path.split("?")[0] == "/v1/generate":
+                if method != "POST":
+                    await self._respond_json(
+                        writer, 405, {"detail": "POST only"})
+                    return
+                await self._handle_generate(reader, writer, headers, body)
+            elif path.split("?")[0] in ("/metrics", "/metrics/"):
+                await self._handle_metrics(writer)
+            elif path.split("?")[0] in ("/healthz", "/healthz/"):
+                await self._handle_healthz(writer)
+            else:
+                await self._respond_json(
+                    writer, 404, {"detail": f"no route {path!r}"})
+        except (ConnectionError, asyncio.IncompleteReadError,
+                asyncio.TimeoutError):
+            pass
+        except ProtocolError as exc:  # framing violation at the read
+            try:                      # layer (bad/oversized length)
+                await self._respond_json(writer, exc.status,
+                                         {"detail": str(exc)})
+            except Exception:
+                pass
+        except Exception:
+            logger.exception("connection handler failed")
+            try:
+                await self._respond_json(
+                    writer, 500, {"detail": "internal error"})
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _read_request(
+        self, reader: asyncio.StreamReader
+    ) -> Optional[Tuple[str, str, Dict[str, str], bytes]]:
+        line = await asyncio.wait_for(
+            reader.readline(), timeout=HEADER_TIMEOUT_S)
+        if not line.strip():
+            return None
+        parts = line.decode("latin-1").split()
+        if len(parts) < 2:
+            return None
+        method, path = parts[0].upper(), parts[1]
+        headers: Dict[str, str] = {}
+        for _ in range(MAX_HEADER_LINES):
+            raw = await asyncio.wait_for(
+                reader.readline(), timeout=HEADER_TIMEOUT_S)
+            if raw in (b"\r\n", b"\n", b""):
+                break
+            key, _, value = raw.decode("latin-1").partition(":")
+            headers[key.strip().lower()] = value.strip()
+        raw_length = headers.get("content-length", "0") or "0"
+        try:
+            length = int(raw_length)
+        except ValueError:
+            raise ProtocolError(
+                f"invalid Content-Length {raw_length!r}") from None
+        if length < 0:
+            raise ProtocolError(f"invalid Content-Length {length}")
+        if length > MAX_BODY_BYTES:
+            raise ProtocolError(f"body too large ({length} bytes)",
+                                status=413)
+        body = await reader.readexactly(length) if length else b""
+        return method, path, headers, body
+
+    async def _respond_json(self, writer: asyncio.StreamWriter, status: int,
+                            payload: Dict[str, Any],
+                            extra_headers: Tuple[Tuple[str, str], ...] = (),
+                            ) -> None:
+        body = json.dumps(payload).encode()
+        head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
+                "Content-Type: application/json",
+                f"Content-Length: {len(body)}",
+                "Connection: close"]
+        head += [f"{k}: {v}" for k, v in extra_headers]
+        writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
+        await writer.drain()
+
+    async def _handle_metrics(self, writer: asyncio.StreamWriter) -> None:
+        merged = dict(self.snapshot())
+        for rid, worker in self.workers.items():
+            for key, value in worker.gauges().items():
+                merged[f"replica_{rid}_{key}"] = value
+        body = render_prometheus(merged).encode()
+        head = ("HTTP/1.1 200 OK\r\n"
+                "Content-Type: text/plain; version=0.0.4\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Connection: close\r\n\r\n").encode()
+        writer.write(head + body)
+        await writer.drain()
+
+    async def _handle_healthz(self, writer: asyncio.StreamWriter) -> None:
+        replicas: Dict[str, Any] = {}
+        any_alive = False
+        for rid, worker in self.workers.items():
+            snap = worker.gauges() if worker.alive else {}
+            any_alive = any_alive or worker.alive
+            replicas[rid] = {
+                "alive": worker.alive,
+                "exit_code": worker.exit_code,
+                "queue_depth": snap.get("queue_depth"),
+                "slot_occupancy": snap.get("slot_occupancy"),
+                "pages_in_use": snap.get("pages_in_use"),
+                "page_pool_free": snap.get("page_pool_free"),
+            }
+        healthy = any_alive and not self._closing
+        payload = {
+            "v": protocol.PROTOCOL_VERSION,
+            "status": ("ok" if healthy
+                       else "draining" if self._closing else "dead"),
+            "backlog": len(self.admission.queue),
+            "replicas": replicas,
+        }
+        await self._respond_json(writer, 200 if healthy else 503, payload)
+
+    # -- generate ----------------------------------------------------------
+    def _inject_tenant_storm(self, count: int) -> None:
+        """The gw_tenant_storm drill: one synthetic tenant floods the
+        fair queue. The storm requests run for real (tiny, 1-2 tokens)
+        but answer no socket — their outcomes land in the drill-side
+        counters so HTTP conservation stays exact."""
+        for _ in range(count):
+            self.metrics.injected_storm_requests += 1
+            req = GenerateRequest(prompt=[1], max_new_tokens=1,
+                                  tenant="storm", stream=False)
+            pending = _Pending(req, deadline=None, synthetic=True)
+            shed = self.admission.offer("storm", pending, float(req.cost))
+            if shed is not None:
+                self.metrics.storm_outcomes[shed.outcome] += 1
+                continue
+            asyncio.ensure_future(self._reap_synthetic(pending))
+        self._wake.set()
+
+    async def _reap_synthetic(self, pending: _Pending) -> None:
+        while True:
+            kind, payload = await pending.chan.get()
+            if kind == "done":
+                self.metrics.storm_outcomes[payload.outcome] += 1
+                return
+            if kind == "local":
+                self.metrics.storm_outcomes[payload[0]] += 1
+                return
+
+    async def _handle_generate(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter,
+                               headers: Dict[str, str],
+                               body: bytes) -> None:
+        self._open_generates += 1
+        try:
+            await self._handle_generate_inner(reader, writer, headers,
+                                              body)
+        finally:
+            self._open_generates -= 1
+
+    async def _handle_generate_inner(self, reader: asyncio.StreamReader,
+                                     writer: asyncio.StreamWriter,
+                                     headers: Dict[str, str],
+                                     body: bytes) -> None:
+        self.metrics.http_requests_received += 1
+        arrival_n = self.metrics.http_requests_received
+        if self.injector is not None:
+            storm = self.injector.take_gw_tenant_storm(arrival_n)
+            if storm:
+                self._inject_tenant_storm(storm)
+        try:
+            req = protocol.parse_generate_request(
+                body, header_tenant=headers.get("x-tenant"))
+        except ProtocolError as exc:
+            self.metrics.record_response(
+                "rejected", protocol.BAD_REQUEST_STATUS)
+            await self._respond_json(
+                writer, protocol.BAD_REQUEST_STATUS,
+                protocol.error_payload(str(exc)))
+            return
+        if self._closing:
+            self.metrics.record_response("rejected", 503)
+            await self._respond_json(
+                writer, 503,
+                protocol.error_payload("gateway is draining"))
+            return
+        ttl = req.ttl_s if req.ttl_s is not None else (
+            self.default_ttl_s if self.default_ttl_s > 0 else None)
+        deadline = time.monotonic() + ttl if ttl else None
+        pending = _Pending(req, deadline=deadline)
+        shed = self.admission.offer(req.tenant, pending, float(req.cost))
+        if shed is not None:
+            status = protocol.STATUS_BY_OUTCOME[shed.outcome]
+            extra: Tuple[Tuple[str, str], ...] = ()
+            retry_s = None
+            if shed.outcome == "shed":  # backing off helps: say how long
+                retry_s = shed.retry_after_s
+                extra = (("Retry-After",
+                          str(max(1, int(round(retry_s))))),)
+            self.metrics.record_response(shed.outcome, status)
+            await self._respond_json(
+                writer, status,
+                protocol.error_payload(
+                    shed.reason, outcome=shed.outcome,
+                    retry_after_s=retry_s),
+                extra_headers=extra)
+            return
+        self._wake.set()
+        if req.stream:
+            await self._stream_response(reader, writer, pending)
+        else:
+            await self._unary_response(writer, pending)
+
+    async def _await_terminal(
+        self, pending: _Pending,
+        on_tokens: Optional[Callable[[List[int]], Any]] = None,
+        disconnect: Optional[asyncio.Task] = None,
+    ) -> Tuple[str, int, Dict[str, Any]]:
+        """Drive one pending request to its terminal record:
+        ``(outcome, http_status, done_payload)``. Streams pass
+        ``on_tokens`` (an async callable writing SSE frames) and a
+        ``disconnect`` watch task; a disconnect mid-flight cancels the
+        request in its engine (pages released) and synthesizes the
+        ``aborted`` terminal."""
+        req = pending.req
+        while True:
+            get = asyncio.ensure_future(pending.chan.get())
+            waits = {get}
+            if disconnect is not None:
+                waits.add(disconnect)
+            done, _ = await asyncio.wait(
+                waits, return_when=asyncio.FIRST_COMPLETED)
+            if disconnect is not None and disconnect in done:
+                detail = "client disconnected mid-stream"
+                if get.done() and not get.cancelled():
+                    # the channel get completed in the SAME loop turn:
+                    # its event must not be dropped — a 'submitted'/
+                    # 'tokens' carries the engine id the cancel needs,
+                    # a 'done' means there is nothing left to cancel
+                    kind, payload = get.result()
+                    if kind == "submitted":
+                        pending.request_id = payload
+                    elif kind == "tokens":
+                        pending.request_id = payload[0]
+                    elif kind == "done":
+                        pending.cancelled = "aborted"  # client gone
+                        return "aborted", \
+                            protocol.STATUS_BY_OUTCOME["aborted"], \
+                            protocol.result_payload(
+                                payload.request_id, outcome="aborted",
+                                finish_reason="aborted",
+                                token_ids=list(payload.tokens),
+                                prompt_tokens=len(req.prompt),
+                                detail=detail)
+                else:
+                    get.cancel()
+                self._cancel_disconnected(pending, detail)
+                return "aborted", protocol.STATUS_BY_OUTCOME["aborted"], \
+                    protocol.result_payload(
+                        pending.request_id if pending.request_id is not None
+                        else -1,
+                        outcome="aborted", finish_reason="aborted",
+                        token_ids=[], prompt_tokens=len(req.prompt),
+                        detail=detail)
+            kind, payload = get.result()
+            if kind == "submitted":
+                pending.request_id = payload
+            elif kind == "tokens":
+                rid, token_ids = payload
+                pending.request_id = rid
+                if on_tokens is not None:
+                    await on_tokens(rid, token_ids)
+            elif kind == "done":
+                result: RequestResult = payload
+                pending.request_id = result.request_id
+                return result.outcome, \
+                    protocol.STATUS_BY_OUTCOME[result.outcome], \
+                    protocol.result_payload(
+                        result.request_id,
+                        outcome=result.outcome,
+                        finish_reason=result.finish_reason,
+                        token_ids=list(result.tokens),
+                        prompt_tokens=len(req.prompt),
+                        detail=result.detail)
+            elif kind == "local":
+                outcome, detail = payload
+                return outcome, protocol.STATUS_BY_OUTCOME[outcome], \
+                    protocol.result_payload(
+                        -1, outcome=outcome, finish_reason=outcome,
+                        token_ids=[], prompt_tokens=len(req.prompt),
+                        detail=detail)
+
+    async def _reap_disconnected(self, pending: _Pending,
+                                 detail: str) -> None:
+        """The stream's handler has already answered ``aborted``; keep
+        consuming the channel until the engine id appears (on the
+        ``submitted`` event or riding a ``tokens`` event), cancel the
+        request there (pages released), and swallow its terminal."""
+        cancelled = False
+        while True:
+            kind, payload = await pending.chan.get()
+            rid = None
+            if kind == "submitted":
+                rid = payload
+            elif kind == "tokens":
+                rid = payload[0]
+            elif kind in ("done", "local"):
+                return
+            if rid is not None and not cancelled \
+                    and pending.replica_id is not None:
+                cancelled = True
+                self.workers[pending.replica_id].cancel(rid, detail)
+
+    async def _unary_response(self, writer: asyncio.StreamWriter,
+                              pending: _Pending) -> None:
+        outcome, status, payload = await self._await_terminal(pending)
+        self._record_outcome(pending, outcome, status)
+        extra: Tuple[Tuple[str, str], ...] = ()
+        if outcome == "shed":
+            # every 429 carries a Retry-After, including fairness
+            # evictions decided after this arrival was queued
+            extra = (("Retry-After", str(max(1, int(round(
+                self.admission.retry_after_hint()))))),)
+        await self._respond_json(writer, status, payload,
+                                 extra_headers=extra)
+
+    async def _stream_response(self, reader: asyncio.StreamReader,
+                               writer: asyncio.StreamWriter,
+                               pending: _Pending) -> None:
+        self.metrics.sse_streams_open += 1
+        self.metrics.sse_streams_total += 1
+        # an SSE client signals disconnect by closing its socket — the
+        # read side completes (EOF/reset) while the stream is mid-flight
+        disconnect = asyncio.ensure_future(self._watch_disconnect(reader))
+        recorded = False
+        try:
+            writer.write((
+                "HTTP/1.1 200 OK\r\n"
+                "Content-Type: text/event-stream\r\n"
+                "Cache-Control: no-cache\r\n"
+                "Connection: close\r\n\r\n").encode())
+            await writer.drain()
+
+            async def _write_tokens(rid: int, token_ids: List[int]) -> None:
+                writer.write(protocol.format_sse_event(
+                    "token", protocol.token_payload(rid, token_ids)))
+                await writer.drain()
+
+            outcome, status, payload = await self._await_terminal(
+                pending, on_tokens=_write_tokens, disconnect=disconnect)
+            self._record_outcome(pending, outcome, status)
+            recorded = True
+            try:
+                writer.write(protocol.format_sse_event("done", payload))
+                await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass  # client gone: the outcome is already recorded
+        except (ConnectionError, OSError):
+            # a WRITE failed before the disconnect watcher saw the EOF —
+            # same situation, same path: cancel the request (pages
+            # released) and record its terminal, or conservation breaks
+            if not recorded:
+                self._cancel_disconnected(pending,
+                                          "client connection lost")
+                self._record_outcome(
+                    pending, "aborted",
+                    protocol.STATUS_BY_OUTCOME["aborted"])
+                recorded = True
+        finally:
+            self.metrics.sse_streams_open -= 1
+            if not disconnect.done():
+                disconnect.cancel()
+
+    def _cancel_disconnected(self, pending: _Pending, detail: str) -> None:
+        """Stop decoding for a dead socket: cancel in the engine if the
+        id is known, otherwise reap it as soon as the id trampolines
+        back; queued-but-undispatched entries are skipped by the
+        dispatcher via ``pending.cancelled``."""
+        if pending.cancelled is not None:
+            return
+        pending.cancelled = "aborted"
+        if pending.replica_id is not None:
+            if pending.request_id is not None:
+                self.workers[pending.replica_id].cancel(
+                    pending.request_id, detail)
+            else:
+                asyncio.ensure_future(
+                    self._reap_disconnected(pending, detail))
+
+    async def _watch_disconnect(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                chunk = await reader.read(4096)
+                if not chunk:
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            return

@@ -1,0 +1,244 @@
+"""Wire schema of the serving gateway: requests, responses, SSE events.
+
+Versioned exactly like the telemetry JSONL envelope (one integer ``v``
+carried on every payload; additive fields keep it, renames/removals/
+semantic changes bump it — the policy of telemetry/export.py). Pure
+stdlib, no jax: the schema is shared by the gateway (server side), the
+smoke client (scripts/gateway_smoke.py) and the tests, and none of them
+should pay a device runtime import to talk JSON.
+
+The HTTP layer speaks the PR 7 terminal-outcome taxonomy: every request
+that reaches the gateway ends in exactly one of
+``inference.resilience.TERMINAL_OUTCOMES`` and every outcome maps to
+exactly one HTTP status (``STATUS_BY_OUTCOME``), so the engine's
+conservation invariant ``requests == sum(outcomes)`` extends to the
+wire — ``http_requests_received == sum(outcomes over HTTP responses)``.
+
+SSE stream grammar (``POST /v1/generate`` with ``stream: true``):
+
+    event: token                     one per engine tick with new tokens
+    data: {"v":1,"request_id":7,"token_ids":[421]}
+
+    event: done                      exactly one, closes the stream
+    data: {"v":1,"request_id":7,"outcome":"ok","finish_reason":"length",
+           "token_ids":[...],"detail":null,
+           "usage":{"prompt_tokens":4,"completion_tokens":16}}
+
+A non-``ok`` terminal rides a ``done`` event too (``outcome`` says
+what happened, partial ``token_ids`` attached) — a stream, once open,
+always ends with exactly one ``done``.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# Bump on renames/removals/semantic changes; additive fields keep it.
+PROTOCOL_VERSION = 1
+
+# The single outcome -> HTTP status mapping (non-streaming responses;
+# streaming responses commit 200 at stream open and carry the outcome on
+# the final `done` event instead). `shed` answers 429 with a Retry-After
+# header so well-behaved clients back off before latency degrades.
+# The keys mirror ``inference.resilience.TERMINAL_OUTCOMES`` exactly —
+# asserted by test_protocol, NOT imported here: this module stays pure
+# stdlib so wire clients (the smoke script, config's tenant-spec parse)
+# never pay a jax import to talk JSON.
+STATUS_BY_OUTCOME: Dict[str, int] = {
+    "ok": 200,
+    "shed": 429,
+    "timeout": 504,
+    "rejected": 503,
+    "quarantined": 500,
+    "aborted": 503,
+}
+
+# Protocol violations (malformed JSON, bad fields) are client errors —
+# they still map onto the taxonomy (outcome `rejected`) so conservation
+# holds, but answer 400, not 503: the request never reached admission.
+BAD_REQUEST_STATUS = 400
+
+DEFAULT_TENANT = "default"
+
+
+class ProtocolError(ValueError):
+    """A request that violates the wire schema. ``status`` is the HTTP
+    answer — 400 by default, e.g. 413 for an oversized body."""
+
+    def __init__(self, message: str,
+                 status: int = BAD_REQUEST_STATUS) -> None:
+        super().__init__(message)
+        self.status = status
+
+
+@dataclass
+class GenerateRequest:
+    """Body of ``POST /v1/generate``.
+
+    ``prompt`` is a non-empty list of token ids (the gateway serves
+    tokens, not text — tokenization is the client's, matching the
+    engine's contract). ``tenant`` scopes fairness/rate limiting (the
+    ``x-tenant`` header is the fallback); ``stream`` selects SSE
+    streaming (default) vs a single JSON response; ``ttl_s`` is the
+    request deadline (None = the gateway's default).
+    """
+
+    prompt: List[int]
+    max_new_tokens: int = 64
+    eos_id: Optional[int] = None
+    seed: int = 0
+    ttl_s: Optional[float] = None
+    tenant: str = DEFAULT_TENANT
+    stream: bool = True
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def cost(self) -> int:
+        """The WFQ/token-bucket service cost: the tokens this request
+        can touch (prompt read + generation budget)."""
+        return len(self.prompt) + self.max_new_tokens
+
+
+def parse_generate_request(
+    body: bytes, *, header_tenant: Optional[str] = None
+) -> GenerateRequest:
+    """Validate a request body into a ``GenerateRequest``; raises
+    ``ProtocolError`` (HTTP 400) with a client-actionable message."""
+    try:
+        obj = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+        raise ProtocolError(f"body is not valid JSON: {exc}") from None
+    if not isinstance(obj, dict):
+        raise ProtocolError(
+            f"body must be a JSON object, got {type(obj).__name__}")
+
+    prompt = obj.get("prompt")
+    if (not isinstance(prompt, list) or not prompt
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       for t in prompt)):
+        raise ProtocolError(
+            "'prompt' must be a non-empty array of integer token ids")
+
+    def _int(name: str, default: int, minimum: int) -> int:
+        v = obj.get(name, default)
+        if not isinstance(v, int) or isinstance(v, bool) or v < minimum:
+            raise ProtocolError(
+                f"'{name}' must be an integer >= {minimum}, got {v!r}")
+        return v
+
+    max_new = _int("max_new_tokens", 64, 1)
+    seed = _int("seed", 0, 0)
+    eos_id = obj.get("eos_id")
+    if eos_id is not None and (not isinstance(eos_id, int)
+                               or isinstance(eos_id, bool)):
+        raise ProtocolError(f"'eos_id' must be an integer, got {eos_id!r}")
+    ttl_s = obj.get("ttl_s")
+    if ttl_s is not None:
+        if not isinstance(ttl_s, (int, float)) or isinstance(ttl_s, bool) \
+                or ttl_s <= 0:
+            raise ProtocolError(
+                f"'ttl_s' must be a positive number, got {ttl_s!r}")
+        ttl_s = float(ttl_s)
+    tenant = obj.get("tenant", header_tenant or DEFAULT_TENANT)
+    if not isinstance(tenant, str) or not tenant:
+        raise ProtocolError(
+            f"'tenant' must be a non-empty string, got {tenant!r}")
+    stream = obj.get("stream", True)
+    if not isinstance(stream, bool):
+        raise ProtocolError(f"'stream' must be a boolean, got {stream!r}")
+    known = {"prompt", "max_new_tokens", "eos_id", "seed", "ttl_s",
+             "tenant", "stream"}
+    return GenerateRequest(
+        prompt=list(prompt), max_new_tokens=max_new, eos_id=eos_id,
+        seed=seed, ttl_s=ttl_s, tenant=tenant, stream=stream,
+        extra={k: v for k, v in obj.items() if k not in known},
+    )
+
+
+# --------------------------------------------------------------------------
+# Server -> client payloads
+# --------------------------------------------------------------------------
+
+
+def result_payload(request_id: int, *, outcome: str, finish_reason: str,
+                   token_ids: List[int], prompt_tokens: int,
+                   detail: Optional[str] = None) -> Dict[str, Any]:
+    """The terminal record of one request — the ``done`` SSE event's
+    data and the whole body of a non-streaming response."""
+    return {
+        "v": PROTOCOL_VERSION,
+        "request_id": request_id,
+        "outcome": outcome,
+        "finish_reason": finish_reason,
+        "token_ids": token_ids,
+        "detail": detail,
+        "usage": {
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": len(token_ids),
+        },
+    }
+
+
+def token_payload(request_id: int, token_ids: List[int]) -> Dict[str, Any]:
+    return {
+        "v": PROTOCOL_VERSION,
+        "request_id": request_id,
+        "token_ids": token_ids,
+    }
+
+
+def error_payload(message: str, *, outcome: str = "rejected",
+                  retry_after_s: Optional[float] = None) -> Dict[str, Any]:
+    """Body of a non-200 JSON response (shed/rejected before a request
+    id exists)."""
+    payload: Dict[str, Any] = {
+        "v": PROTOCOL_VERSION,
+        "outcome": outcome,
+        "detail": message,
+    }
+    if retry_after_s is not None:
+        payload["retry_after_s"] = retry_after_s
+    return payload
+
+
+# --------------------------------------------------------------------------
+# SSE framing
+# --------------------------------------------------------------------------
+
+
+def format_sse_event(event: str, payload: Dict[str, Any]) -> bytes:
+    """One Server-Sent-Events frame: ``event:`` + single-line ``data:``
+    (the payload is JSON, which never embeds a raw newline)."""
+    return (f"event: {event}\ndata: {json.dumps(payload)}\n\n"
+            ).encode("utf-8")
+
+
+def parse_sse_stream(raw: bytes) -> List[Tuple[str, Dict[str, Any]]]:
+    """Decode a full SSE byte stream into ``(event, payload)`` pairs —
+    the client half of ``format_sse_event`` (smoke script + tests)."""
+    events: List[Tuple[str, Dict[str, Any]]] = []
+    for frame in raw.decode("utf-8").split("\n\n"):
+        if not frame.strip():
+            continue
+        name, data = "message", None
+        for line in frame.split("\n"):
+            if line.startswith("event:"):
+                name = line[len("event:"):].strip()
+            elif line.startswith("data:"):
+                payload = line[len("data:"):].strip()
+                data = json.loads(payload) if payload else None
+        if data is not None:
+            events.append((name, data))
+    return events
+
+
+def stream_tokens(events: List[Tuple[str, Dict[str, Any]]]) -> List[int]:
+    """Concatenate a stream's ``token`` events — must equal the ``done``
+    event's ``token_ids`` bit-exactly (the acceptance oracle)."""
+    out: List[int] = []
+    for name, payload in events:
+        if name == "token":
+            out.extend(payload["token_ids"])
+    return out

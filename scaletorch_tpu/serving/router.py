@@ -1,0 +1,236 @@
+"""Prefix-cache-aware multi-replica routing.
+
+With N engine replicas behind one gateway, WHERE a request lands decides
+whether its prompt prefix is a radix-tree hit or a cold prefill: the
+replica that served the last request with this system prompt already
+holds those pages (inference/kv_cache.RadixPrefixCache), every other
+replica would prefill them again. So the routing key IS the radix tree's
+chunk identity — the page-aligned token chunks of the prompt, hashed
+cumulatively (chunk i's hash folds in chunk i-1's), which makes two
+prompts collide exactly when they share a page-aligned prefix, the same
+granularity at which the tree can share pages.
+
+Routing walks the request's chunk-hash chain through a learned
+owner map (deepest known hash wins — the replica that most recently
+served the LONGEST matching prefix), falls back to rendezvous (highest-
+random-weight) hashing on the first chunk for cold prefixes — so
+repeats of a brand-new system prompt still converge on one replica
+without any coordination — and on the full prompt for sub-page prompts.
+
+Replica health rides the exit-code contract (docs/fault_tolerance.md):
+``report_exit(replica, code)`` with 0 = clean drain (leaves rotation
+quietly), 42/43/44 or any other non-zero = dead (ejected, its owner-map
+entries lazily dropped, its in-flight work the gateway's to abort). The
+same slice-to-slice page-affinity key is the substrate the MPMD
+disaggregation direction needs (ROADMAP: page handoff between slices).
+
+Pure host-side stdlib — no jax; the gateway and the tests drive it
+directly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from scaletorch_tpu.utils.logger import get_logger
+
+logger = get_logger(__name__)
+
+# Exit codes that mean "replica crashed" (the 0/42/43/44 contract;
+# anything non-zero ejects, these get named in the log line).
+CRASH_EXIT_CODES = {
+    42: "training divergence",
+    43: "hang watchdog",
+    44: "serving stall watchdog",
+}
+
+
+def page_chunk_hashes(prompt: Sequence[int], page_size: int,
+                      *, max_chunks: int = 32) -> List[str]:
+    """Cumulative hashes of the prompt's page-aligned chunks — the
+    routing key chain. ``hashes[i]`` identifies the first ``(i+1) *
+    page_size`` tokens, so a shared system prompt shares a hash PREFIX
+    of the chain exactly as it shares a page-aligned path in the radix
+    tree. Only full pages hash (the tree only registers frozen full
+    pages); ``max_chunks`` caps the chain — prefix reuse lives at the
+    head of the prompt, and an unbounded chain would make the owner map
+    O(prompt) per request."""
+    if page_size < 1:
+        raise ValueError(f"page_size must be >= 1, got {page_size}")
+    out: List[str] = []
+    h = hashlib.sha1()
+    n_full = min(len(prompt) // page_size, max_chunks)
+    for c in range(n_full):
+        chunk = prompt[c * page_size:(c + 1) * page_size]
+        h.update(b"|".join(str(t).encode() for t in chunk) + b";")
+        out.append(h.hexdigest())
+    return out
+
+
+def _rendezvous(key: str, replicas: Sequence[str]) -> str:
+    """Highest-random-weight hash: stable under replica set changes —
+    only the keys owned by a removed replica move."""
+    return max(
+        replicas,
+        key=lambda r: hashlib.sha1(f"{key}@{r}".encode()).digest(),
+    )
+
+
+@dataclass
+class ReplicaState:
+    """Router-side view of one replica."""
+
+    replica_id: str
+    healthy: bool = True
+    exit_code: Optional[int] = None
+    dispatched: int = 0
+    routed_by_prefix: int = 0  # landed via a learned owner-map entry
+    extra: dict = field(default_factory=dict)
+
+
+class PrefixAwareRouter:
+    """Route requests to the replica whose radix tree holds their
+    prefix; rendezvous-hash cold prefixes; eject dead replicas.
+
+    ``prefix_aware=False`` degrades to consistent hashing of the FULL
+    prompt — the baseline the acceptance test beats: identical prompts
+    still stick, but prompts sharing only a *prefix* scatter, so the
+    per-replica radix trees never concentrate a shared system prompt.
+    """
+
+    def __init__(
+        self,
+        replica_ids: Sequence[str],
+        page_size: int,
+        *,
+        prefix_aware: bool = True,
+        max_tracked_prefixes: int = 65536,
+        max_chunks: int = 32,
+    ) -> None:
+        if not replica_ids:
+            raise ValueError("router needs at least one replica")
+        if len(set(replica_ids)) != len(replica_ids):
+            raise ValueError(f"duplicate replica ids: {list(replica_ids)}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.prefix_aware = prefix_aware
+        self.max_chunks = max_chunks
+        self.replicas: Dict[str, ReplicaState] = {
+            rid: ReplicaState(replica_id=rid) for rid in replica_ids}
+        # chunk hash -> replica id, LRU-bounded (move_to_end on touch)
+        self._owners: "OrderedDict[str, str]" = OrderedDict()
+        self._max_tracked = max_tracked_prefixes
+
+    # -- membership --------------------------------------------------------
+    def alive(self) -> List[str]:
+        return [rid for rid, st in self.replicas.items() if st.healthy]
+
+    def mark_dead(self, replica_id: str,
+                  exit_code: Optional[int] = None) -> None:
+        """Eject a replica (exit-code contract or an observed failure).
+        Its owner-map entries are dropped so sticky prefixes re-route
+        to a survivor on their next arrival."""
+        st = self.replicas[replica_id]
+        if not st.healthy:
+            return
+        st.healthy = False
+        st.exit_code = exit_code
+        reason = CRASH_EXIT_CODES.get(exit_code, "unhealthy") \
+            if exit_code is not None else "unhealthy"
+        logger.warning(
+            "router: replica %s ejected (%s%s); %d remain",
+            replica_id, reason,
+            f", exit {exit_code}" if exit_code is not None else "",
+            len(self.alive()),
+        )
+        stale = [k for k, v in self._owners.items() if v == replica_id]
+        for k in stale:
+            del self._owners[k]
+
+    def report_exit(self, replica_id: str, exit_code: int) -> None:
+        """Apply the 0/42/43/44 exit-code contract: 0 is a clean drain
+        (the replica leaves rotation without alarm), anything else is a
+        crash ejection."""
+        if exit_code == 0:
+            st = self.replicas[replica_id]
+            st.healthy = False
+            st.exit_code = 0
+            stale = [k for k, v in self._owners.items() if v == replica_id]
+            for k in stale:
+                del self._owners[k]
+            logger.info("router: replica %s drained cleanly (exit 0)",
+                        replica_id)
+        else:
+            self.mark_dead(replica_id, exit_code)
+
+    # -- routing -----------------------------------------------------------
+    def route(self, prompt: Sequence[int]) -> str:
+        """Pick the replica for one request and learn from the choice.
+        Raises ``NoReplicaAvailable`` when every replica is gone."""
+        alive = self.alive()
+        if not alive:
+            raise NoReplicaAvailable("no healthy replica in rotation")
+        chain = (
+            page_chunk_hashes(prompt, self.page_size,
+                              max_chunks=self.max_chunks)
+            if self.prefix_aware else []
+        )
+        chosen: Optional[str] = None
+        via_prefix = False
+        # deepest learned owner wins: the replica whose tree holds the
+        # LONGEST registered prefix of this prompt
+        for h in reversed(chain):
+            owner = self._owners.get(h)
+            if owner is not None and self.replicas[owner].healthy:
+                chosen = owner
+                via_prefix = True
+                break
+        if chosen is None:
+            # cold prefix: rendezvous on the FIRST chunk so future
+            # requests sharing the head converge without coordination;
+            # sub-page prompts (no chunks) key on the whole prompt.
+            # prefix_aware=False keys on the whole prompt always — the
+            # consistent-hash-only baseline.
+            key = chain[0] if chain else "|".join(str(t) for t in prompt)
+            chosen = _rendezvous(key, alive)
+        st = self.replicas[chosen]
+        st.dispatched += 1
+        if via_prefix:
+            st.routed_by_prefix += 1
+        if self.prefix_aware:
+            # the chosen replica's tree will hold every full page of
+            # this prompt once its prefill registers — learn the chain
+            for h in chain:
+                self._owners[h] = chosen
+                self._owners.move_to_end(h)
+            while len(self._owners) > self._max_tracked:
+                self._owners.popitem(last=False)
+        return chosen
+
+    # -- metrics -----------------------------------------------------------
+    def snapshot(self) -> Dict[str, float]:
+        """Flat numeric gauges for the gateway metrics surface."""
+        alive = self.alive()
+        dispatched = sum(s.dispatched for s in self.replicas.values())
+        by_prefix = sum(s.routed_by_prefix for s in self.replicas.values())
+        snap: Dict[str, float] = {
+            "router_replicas_alive": float(len(alive)),
+            "router_replicas_dead": float(
+                len(self.replicas) - len(alive)),
+            "router_dispatched": float(dispatched),
+            "router_routed_by_prefix": float(by_prefix),
+            "router_prefix_route_rate": (
+                by_prefix / dispatched if dispatched else 0.0),
+            "router_tracked_prefixes": float(len(self._owners)),
+        }
+        for rid, st in self.replicas.items():
+            snap[f"router_dispatched_{rid}"] = float(st.dispatched)
+        return snap
+
+
+class NoReplicaAvailable(RuntimeError):
+    """Every replica is dead or drained — the gateway answers 503."""

@@ -8,10 +8,11 @@ metrics they can *parse*, not console lines. Two surfaces:
     line) merging the trainer's ``MetricsLogger`` step records and the
     engine's ``EngineMetrics`` snapshots into ONE schema-versioned
     format. Each line carries ``v`` (schema version), ``kind``
-    (``train_step`` / ``engine_metrics`` / free-form), ``time`` and
-    ``proc``; the rest is the flat numeric record. Version policy:
-    additive field changes keep ``v``; renames/removals/semantic
-    changes bump it (docs/observability.md).
+    (one of ``KNOWN_KINDS`` — ``train_step`` / ``engine_metrics`` /
+    ``gateway_metrics`` — or free-form), ``time`` and ``proc``; the
+    rest is the flat numeric record. Version policy: additive field
+    changes keep ``v``; renames/removals/semantic changes bump it
+    (docs/observability.md).
   * ``PrometheusEndpoint`` — an optional stdlib-only HTTP endpoint
     serving the text exposition format from a caller-supplied
     ``metrics_fn`` (e.g. ``engine.metrics.snapshot``), so live
@@ -36,6 +37,15 @@ from scaletorch_tpu.utils.logger import get_logger
 
 # Bump on renames/removals/semantic changes; additive fields keep it.
 SCHEMA_VERSION = 1
+
+# The event kinds the framework itself emits on the JSONL stream — ONE
+# schema, no parallel pipelines: the trainer's per-step records
+# (trainer/metrics.py), the engine's EngineMetrics snapshots
+# (inference/engine.py) and the gateway's GatewayMetrics snapshots
+# (serving/gateway.py: per-tenant queue depth, shed/429 counts, SSE
+# streams open, router prefix-hit rate). Free-form kinds are allowed;
+# these are the ones consumers can rely on.
+KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics")
 
 
 class TelemetryExporter:

@@ -1,0 +1,152 @@
+#!/usr/bin/env python
+"""Gateway smoke: boot scripts/serve.py, stream one SSE request, verify.
+
+The CI ``gateway-smoke`` step (tier1.yml) runs this end to end on a CPU
+mesh:
+
+  1. boot ``scripts/serve.py --preset tiny`` as a real subprocess and
+     wait for its ``READY port=<p>`` line;
+  2. stream one greedy request over HTTP via urllib (SSE);
+  3. rebuild the SAME deterministic tiny engine in-process (same
+     ``--param_seed``) and assert the streamed tokens equal the direct
+     ``InferenceEngine`` run BIT-FOR-BIT (the acceptance oracle: the
+     gateway adds transport, never arithmetic);
+  4. scrape ``/healthz`` and ``/metrics``;
+  5. SIGTERM the server and assert it drains to exit code 0 (the
+     exit-code contract's clean drain).
+
+Exit 0 = all green; any assertion prints a diagnostic and exits 1.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+PROMPT = [1, 2, 3, 5, 8]
+MAX_NEW = 12
+SEED = 7
+SERVE_ARGS = [
+    "--preset", "tiny", "--param_seed", str(SEED),
+    "--max_slots", "2", "--max_seq", "64", "--prefill_len", "16",
+    "--cache_layout", "paged", "--page_size", "4",
+    "--serve_port", "0",
+]
+
+
+def pump_output(proc: subprocess.Popen) -> "queue.Queue":
+    """Echo the child's stdout from a reader thread so the deadline in
+    ``wait_ready`` stays real — a wedged server that prints nothing must
+    FAIL at the timeout, not hang CI on a blocking readline."""
+    lines: "queue.Queue" = queue.Queue()
+
+    def _pump() -> None:
+        for line in proc.stdout:
+            sys.stdout.write(f"[serve] {line}")
+            sys.stdout.flush()
+            lines.put(line)
+        lines.put(None)  # EOF
+
+    threading.Thread(target=_pump, daemon=True).start()
+    return lines
+
+
+def wait_ready(lines: "queue.Queue", proc: subprocess.Popen,
+               timeout_s: float = 120.0) -> int:
+    """Watch the pumped stdout until ``READY port=<p>``."""
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            line = lines.get(timeout=1.0)
+        except queue.Empty:
+            continue
+        if line is None:
+            raise AssertionError(
+                f"server exited early (rc={proc.poll()})")
+        if line.startswith("READY port="):
+            return int(line.strip().split("=", 1)[1])
+    raise AssertionError(f"server never printed READY in {timeout_s:g}s")
+
+
+def direct_engine_tokens() -> list:
+    """The oracle: the same deterministic engine, no HTTP in sight."""
+    import serve as serve_mod
+
+    args = serve_mod.parse_args(SERVE_ARGS)
+    cfg, params = serve_mod.build_model(args)
+    engine = serve_mod.build_engine(args, cfg, params)
+    rid = engine.submit(PROMPT, max_new_tokens=MAX_NEW)
+    return engine.run()[rid].tokens
+
+
+def main() -> int:
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+         *SERVE_ARGS],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+    try:
+        lines = pump_output(proc)
+        port = wait_ready(lines, proc)
+        base = f"http://127.0.0.1:{port}"
+
+        body = json.dumps({"prompt": PROMPT, "max_new_tokens": MAX_NEW,
+                           "stream": True}).encode()
+        raw = urllib.request.urlopen(
+            urllib.request.Request(f"{base}/v1/generate", data=body,
+                                   method="POST"),
+            timeout=120).read()
+        from scaletorch_tpu.serving.protocol import (
+            parse_sse_stream,
+            stream_tokens,
+        )
+
+        events = parse_sse_stream(raw)
+        streamed = stream_tokens(events)
+        dones = [d for e, d in events if e == "done"]
+        assert len(dones) == 1, f"expected exactly one done event: {events}"
+        assert dones[0]["outcome"] == "ok", dones[0]
+        assert streamed == dones[0]["token_ids"], (streamed, dones[0])
+
+        reference = direct_engine_tokens()
+        assert streamed == reference, (
+            f"SSE stream diverged from the direct engine:\n"
+            f"  streamed:  {streamed}\n  reference: {reference}")
+        print(f"[smoke] SSE bit-parity OK over {len(streamed)} tokens")
+
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=30).read())
+        assert health["status"] == "ok", health
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30).read().decode()
+        assert "scaletorch_http_requests_received 1.0" in metrics, \
+            metrics[:400]
+        print("[smoke] /healthz + /metrics OK")
+
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=120)  # the pump thread echoes the tail
+        assert rc == 0, f"drain exit code {rc}, want 0"
+        print("[smoke] SIGTERM drain exit 0 OK")
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Launch the serving gateway over one or more engine replicas.
+
+The production shape is ``--model_name_or_path`` + HF safetensors; the
+hermetic shape (CI's gateway-smoke, local development) is ``--preset
+tiny``: a deterministic tiny Llama initialized from ``--param_seed`` so
+a second process can rebuild the EXACT same model and compare streamed
+tokens bit-for-bit (scripts/gateway_smoke.py does).
+
+Prints ``READY port=<port>`` on stdout once the socket is bound.
+SIGTERM/SIGINT drain gracefully — in-flight streams finish, queued
+requests end ``aborted``, replicas stop at refcount-clean page pools —
+and the process exits 0 (the exit-code contract's "clean drain").
+
+Examples
+--------
+  # tiny deterministic model, paged cache, two replicas:
+  JAX_PLATFORMS=cpu python scripts/serve.py --preset tiny \\
+      --serve_replicas 2 --serve_port 8000
+
+  # talk to it:
+  curl -N -X POST http://127.0.0.1:8000/v1/generate \\
+      -d '{"prompt": [1, 2, 3], "max_new_tokens": 8}'
+  curl http://127.0.0.1:8000/healthz
+  curl http://127.0.0.1:8000/metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+TINY = dict(
+    vocab_size=64, hidden_size=32, intermediate_size=64,
+    num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+)
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--preset", default="tiny",
+                   help="'tiny' (deterministic tiny Llama from "
+                        "--param_seed) or a models/presets.py name "
+                        "(random init unless --model_name_or_path).")
+    p.add_argument("--model_name_or_path", default=None,
+                   help="HF checkpoint dir for real weights "
+                        "(utils/hf_interop.load_hf_params).")
+    p.add_argument("--param_seed", type=int, default=0)
+    p.add_argument("--max_slots", type=int, default=4)
+    p.add_argument("--max_seq", type=int, default=128)
+    p.add_argument("--prefill_len", type=int, default=64)
+    p.add_argument("--cache_layout", default="paged",
+                   choices=("dense", "paged"))
+    p.add_argument("--page_size", type=int, default=16)
+    p.add_argument("--serve_host", default="127.0.0.1")
+    p.add_argument("--serve_port", type=int, default=8000)
+    p.add_argument("--serve_replicas", type=int, default=1)
+    p.add_argument("--serve_tenants", default="",
+                   help="'name:weight[:rate[:burst]],...' "
+                        "(config.ServingArguments grammar)")
+    p.add_argument("--serve_default_weight", type=float, default=1.0)
+    p.add_argument("--serve_max_backlog", type=int, default=256)
+    p.add_argument("--serve_free_page_watermark", type=float, default=0.05)
+    p.add_argument("--serve_default_ttl_s", type=float, default=0.0)
+    p.add_argument("--telemetry_dir", default=None,
+                   help="Write gateway_metrics JSONL here "
+                        "(telemetry/export.py schema).")
+    # gateway fault drills (ServingFaultInjector.from_config reads the
+    # same field names; env SCALETORCH_TPU_FT_GW_* wins when present)
+    p.add_argument("--ft_gw_tenant_storm_at", type=int, default=0)
+    p.add_argument("--ft_gw_tenant_storm_count", type=int, default=8)
+    p.add_argument("--ft_gw_replica_down_at", type=int, default=0)
+    return p.parse_args(argv)
+
+
+def build_model(args):
+    """(cfg, params) — deterministic for preset 'tiny' + a seed."""
+    import jax
+    import jax.numpy as jnp
+
+    from scaletorch_tpu.models import llama
+
+    if args.preset == "tiny":
+        cfg = llama.LlamaConfig(dtype=jnp.float32, **TINY)
+        params = llama.init_params(jax.random.PRNGKey(args.param_seed), cfg)
+        return cfg, params
+    import dataclasses
+
+    from scaletorch_tpu.models.presets import preset
+
+    known = {f.name for f in dataclasses.fields(llama.LlamaConfig)}
+    kwargs = {k: v for k, v in preset(args.preset).items() if k in known}
+    cfg = llama.LlamaConfig(
+        qk_norm=preset(args.preset).get("model_type") == "qwen3", **kwargs)
+    if args.model_name_or_path:
+        from scaletorch_tpu.utils.hf_interop import load_hf_params
+
+        return cfg, load_hf_params(args.model_name_or_path, cfg)
+    return cfg, llama.init_params(jax.random.PRNGKey(args.param_seed), cfg)
+
+
+def build_engine(args, cfg, params):
+    from scaletorch_tpu.inference import InferenceEngine, SamplingParams
+
+    return InferenceEngine(
+        params, cfg,
+        max_slots=args.max_slots, max_seq=args.max_seq,
+        prefill_len=args.prefill_len,
+        sampling=SamplingParams(temperature=0.0),
+        cache_layout=args.cache_layout, page_size=args.page_size,
+        strict_submit=False,
+    )
+
+
+def build_gateway(args):
+    from scaletorch_tpu.inference.resilience import ServingFaultInjector
+    from scaletorch_tpu.serving.admission import parse_tenant_spec
+    from scaletorch_tpu.serving.gateway import ServingGateway
+
+    cfg, params = build_model(args)
+    engines = {
+        f"r{i}": build_engine(args, cfg, params)
+        for i in range(args.serve_replicas)
+    }
+    injector = ServingFaultInjector.from_config(args)
+    exporter = None
+    if args.telemetry_dir:
+        from scaletorch_tpu.telemetry.export import TelemetryExporter
+
+        exporter = TelemetryExporter(
+            os.path.join(args.telemetry_dir, "gateway_events.jsonl"))
+    return ServingGateway(
+        engines,
+        host=args.serve_host, port=args.serve_port,
+        tenants=parse_tenant_spec(args.serve_tenants),
+        default_weight=args.serve_default_weight,
+        max_backlog=args.serve_max_backlog,
+        free_page_watermark=args.serve_free_page_watermark,
+        default_ttl_s=args.serve_default_ttl_s,
+        injector=injector if injector.active else None,
+        exporter=exporter,
+    )
+
+
+async def _main(args) -> int:
+    gateway = build_gateway(args)
+    await gateway.start()
+    print(f"READY port={gateway.port}", flush=True)
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    serve = asyncio.ensure_future(gateway.serve_forever())
+    await stop.wait()
+    print("draining gateway...", flush=True)
+    await gateway.stop(drain=True)
+    serve.cancel()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    return asyncio.run(_main(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

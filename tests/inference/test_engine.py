@@ -163,6 +163,113 @@ class TestContinuousBatching:
         assert "tokens_generated" in mon.records[-1]
 
 
+class TestTokenHookAndCancel:
+    """ISSUE 11 satellites: the streaming bridge's engine surface —
+    per-tick ``on_tokens`` push, ``tick()`` driving, ``cancel()``."""
+
+    def test_on_tokens_concatenates_to_final_result_bit_exactly(
+            self, tiny_llama):
+        cfg, params = tiny_llama
+        streamed = {}
+
+        def hook(slot, request_id, token_ids):
+            streamed.setdefault(request_id, []).extend(token_ids)
+
+        eng = InferenceEngine(params, cfg, max_slots=2, max_seq=32,
+                              prefill_len=8,
+                              sampling=SamplingParams(temperature=0.0),
+                              on_tokens=hook)
+        prompts = [[1, 2, 3], [9, 8], [4, 5, 6, 7], [11]]
+        ids = [eng.submit(p, max_new_tokens=n)
+               for p, n in zip(prompts, [6, 3, 5, 4])]
+        results = eng.run()
+        for rid in ids:
+            assert streamed[rid] == results[rid].tokens  # bit-exact
+        assert eng.decode_compile_count == 1  # the hook adds no retrace
+
+    def test_on_tokens_pushed_per_tick_not_at_terminal(self, tiny_llama):
+        """The hook must fire DURING generation (push), not once at the
+        end: drive tick-by-tick and watch tokens arrive incrementally."""
+        cfg, params = tiny_llama
+        seen = []
+        eng = InferenceEngine(
+            params, cfg, max_slots=1, max_seq=32, prefill_len=8,
+            sampling=SamplingParams(temperature=0.0),
+            on_tokens=lambda s, r, t: seen.extend(t))
+        eng.submit([1, 2, 3], max_new_tokens=5)
+        counts = []
+        while eng.pending:
+            eng.tick()
+            counts.append(len(seen))
+        assert len(seen) == 5
+        assert counts == sorted(counts) and len(set(counts)) > 2
+
+    def test_raising_hook_is_disarmed_not_fatal(self, tiny_llama):
+        cfg, params = tiny_llama
+
+        def bad_hook(slot, request_id, token_ids):
+            raise RuntimeError("consumer bug")
+
+        eng = InferenceEngine(params, cfg, max_slots=1, max_seq=32,
+                              prefill_len=8,
+                              sampling=SamplingParams(temperature=0.0),
+                              on_tokens=bad_hook)
+        rid = eng.submit([1, 2, 3], max_new_tokens=4)
+        results = eng.run()
+        assert results[rid].outcome == "ok"
+        assert eng.on_tokens is None  # disarmed after the first raise
+
+    def test_cancel_queued_and_mid_decode(self, tiny_llama):
+        cfg, params = tiny_llama
+        eng = InferenceEngine(params, cfg, max_slots=1, max_seq=32,
+                              prefill_len=8,
+                              sampling=SamplingParams(temperature=0.0))
+        active = eng.submit([1, 2, 3], max_new_tokens=10)
+        queued = eng.submit([4, 5], max_new_tokens=10)
+        eng.step()                      # admit + first decode of `active`
+        assert eng.cancel(queued, detail="client gone")
+        finished = eng.step()           # the cancel is delivered this tick
+        assert any(r.request_id == queued and r.outcome == "aborted"
+                   for r in finished)
+        assert eng.cancel(active)
+        assert eng.result(active).outcome == "aborted"
+        assert eng.result(active).tokens  # partials attached
+        assert not eng.cancel(active)   # already terminal
+        assert not eng.cancel(12345)    # unknown id
+        # conservation holds across cancels
+        assert sum(eng.metrics.outcomes.values()) == 2
+
+    def test_cancel_releases_pages(self, tiny_llama):
+        cfg, params = tiny_llama
+        eng = InferenceEngine(params, cfg, max_slots=1, max_seq=32,
+                              prefill_len=8, cache_layout="paged",
+                              page_size=4,
+                              sampling=SamplingParams(temperature=0.0))
+        rid = eng.submit([1, 2, 3, 4, 5], max_new_tokens=20)
+        eng.step()
+        assert eng.metrics.pages_in_use > 0
+        assert eng.cancel(rid)
+        eng.allocator.check_conservation()
+        # only the radix tree's own references may remain
+        assert all(c == 1 for c in eng.allocator._ref.values())
+
+    def test_stop_admissions_without_tick_loop(self, tiny_llama):
+        """The bridge-owned drain: stop_admissions() blocks submits but
+        the owner keeps ticking in-flight work to completion."""
+        cfg, params = tiny_llama
+        eng = InferenceEngine(params, cfg, max_slots=1, max_seq=32,
+                              prefill_len=8, strict_submit=False,
+                              sampling=SamplingParams(temperature=0.0))
+        rid = eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.stop_admissions()
+        late = eng.submit([7], max_new_tokens=2)
+        assert eng.result(late).outcome == "rejected"
+        while eng.pending:
+            eng.tick()
+        assert eng.result(rid).outcome == "ok"
+        assert len(eng.result(rid).tokens) == 4
+
+
 class TestShardedServing:
     def test_tp_sharded_cache_matches_unsharded(self, tiny_llama, mm_factory):
         """ISSUE 4 acceptance: the TP-sharded cache path runs green on

@@ -1,0 +1,117 @@
+"""Wire-schema tests: request validation, SSE framing, outcome mapping.
+
+Pure host-side (no engine, no HTTP) — the protocol module is stdlib by
+design and these run in milliseconds.
+"""
+
+import json
+
+import pytest
+
+from scaletorch_tpu.inference.resilience import TERMINAL_OUTCOMES
+from scaletorch_tpu.serving import protocol
+from scaletorch_tpu.serving.protocol import (
+    PROTOCOL_VERSION,
+    STATUS_BY_OUTCOME,
+    ProtocolError,
+    format_sse_event,
+    parse_generate_request,
+    parse_sse_stream,
+    stream_tokens,
+)
+
+
+class TestRequestParsing:
+    def test_minimal_request(self):
+        req = parse_generate_request(b'{"prompt": [1, 2, 3]}')
+        assert req.prompt == [1, 2, 3]
+        assert req.max_new_tokens == 64
+        assert req.stream is True
+        assert req.tenant == "default"
+        assert req.ttl_s is None
+        assert req.cost == 3 + 64
+
+    def test_full_request(self):
+        body = json.dumps({
+            "prompt": [5], "max_new_tokens": 8, "eos_id": 2, "seed": 9,
+            "ttl_s": 1.5, "tenant": "pro", "stream": False,
+            "x_custom": "kept",
+        }).encode()
+        req = parse_generate_request(body)
+        assert (req.max_new_tokens, req.eos_id, req.seed) == (8, 2, 9)
+        assert req.ttl_s == 1.5
+        assert req.tenant == "pro"
+        assert req.stream is False
+        assert req.extra == {"x_custom": "kept"}
+
+    def test_header_tenant_fallback_body_wins(self):
+        req = parse_generate_request(
+            b'{"prompt": [1]}', header_tenant="hdr")
+        assert req.tenant == "hdr"
+        req = parse_generate_request(
+            b'{"prompt": [1], "tenant": "body"}', header_tenant="hdr")
+        assert req.tenant == "body"
+
+    @pytest.mark.parametrize("body, match", [
+        (b"not json", "valid JSON"),
+        (b"[1,2]", "JSON object"),
+        (b"{}", "prompt"),
+        (b'{"prompt": []}', "prompt"),
+        (b'{"prompt": [1.5]}', "prompt"),
+        (b'{"prompt": [true]}', "prompt"),
+        (b'{"prompt": "text"}', "prompt"),
+        (b'{"prompt": [1], "max_new_tokens": 0}', "max_new_tokens"),
+        (b'{"prompt": [1], "max_new_tokens": "8"}', "max_new_tokens"),
+        (b'{"prompt": [1], "seed": -1}', "seed"),
+        (b'{"prompt": [1], "eos_id": "x"}', "eos_id"),
+        (b'{"prompt": [1], "ttl_s": 0}', "ttl_s"),
+        (b'{"prompt": [1], "ttl_s": -2}', "ttl_s"),
+        (b'{"prompt": [1], "tenant": ""}', "tenant"),
+        (b'{"prompt": [1], "stream": 1}', "stream"),
+    ])
+    def test_rejects_malformed(self, body, match):
+        with pytest.raises(ProtocolError, match=match):
+            parse_generate_request(body)
+
+
+class TestOutcomeMapping:
+    def test_every_outcome_has_exactly_one_status(self):
+        assert set(STATUS_BY_OUTCOME) == set(TERMINAL_OUTCOMES)
+        assert STATUS_BY_OUTCOME["ok"] == 200
+        assert STATUS_BY_OUTCOME["shed"] == 429
+        assert STATUS_BY_OUTCOME["timeout"] == 504
+        assert STATUS_BY_OUTCOME["rejected"] == 503
+
+    def test_payloads_carry_version(self):
+        done = protocol.result_payload(
+            3, outcome="ok", finish_reason="length", token_ids=[1, 2],
+            prompt_tokens=4)
+        assert done["v"] == PROTOCOL_VERSION
+        assert done["usage"] == {"prompt_tokens": 4,
+                                 "completion_tokens": 2}
+        assert protocol.token_payload(3, [7])["v"] == PROTOCOL_VERSION
+        err = protocol.error_payload("too busy", outcome="shed",
+                                     retry_after_s=2.0)
+        assert err["v"] == PROTOCOL_VERSION
+        assert err["retry_after_s"] == 2.0
+
+
+class TestSSEFraming:
+    def test_round_trip(self):
+        raw = b"".join([
+            format_sse_event("token", protocol.token_payload(1, [4])),
+            format_sse_event("token", protocol.token_payload(1, [5, 6])),
+            format_sse_event("done", protocol.result_payload(
+                1, outcome="ok", finish_reason="length",
+                token_ids=[4, 5, 6], prompt_tokens=2)),
+        ])
+        events = parse_sse_stream(raw)
+        assert [name for name, _ in events] == ["token", "token", "done"]
+        assert stream_tokens(events) == [4, 5, 6]
+        assert events[-1][1]["token_ids"] == [4, 5, 6]
+
+    def test_partial_noise_tolerated(self):
+        raw = (b": comment\n\n"
+               + format_sse_event("token", protocol.token_payload(0, [9])))
+        events = parse_sse_stream(raw)
+        assert stream_tokens(events) == [9]
