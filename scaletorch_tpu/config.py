@@ -824,6 +824,18 @@ class ServingArguments:
         metadata={"help": "In-process engine replicas behind the "
                           "prefix-aware router (scripts/serve.py)."},
     )
+    serve_slo_path: str = field(
+        default="",
+        metadata={"help": "SLO target file (tools/slo.json grammar, see "
+                          "serving/slo.py); when set, /healthz carries a "
+                          "live 'slo' verdict and tools/slo_check.py "
+                          "grades the telemetry artifacts against it. "
+                          "'' disables."},
+    )
+    serve_slo_preset: str = field(
+        default="tiny",
+        metadata={"help": "Preset name inside serve_slo_path."},
+    )
 
     def __post_init__(self) -> None:
         if self.serve_port < 0:
@@ -854,6 +866,18 @@ class ServingArguments:
             from scaletorch_tpu.serving.admission import parse_tenant_spec
 
             parse_tenant_spec(self.serve_tenants)
+        if self.serve_slo_path:
+            # same parse-time discipline for the SLO file: a typo'd
+            # path or malformed target key fails the CLI, not /healthz
+            from scaletorch_tpu.serving.slo import load_slo, preset_targets
+
+            try:
+                preset_targets(load_slo(self.serve_slo_path),
+                               self.serve_slo_preset)
+            except OSError as exc:
+                raise ValueError(
+                    f"serve_slo_path {self.serve_slo_path!r} is not "
+                    f"readable: {exc}") from None
 
 
 @dataclass

@@ -77,6 +77,7 @@ from scaletorch_tpu.inference.resilience import (
     ServingFaultInjector,
 )
 from scaletorch_tpu.inference.sampling import SamplingParams
+from scaletorch_tpu.telemetry.histogram import LogHistogram
 from scaletorch_tpu.telemetry.spans import NOOP_SPAN
 from scaletorch_tpu.utils.logger import get_logger
 
@@ -89,7 +90,11 @@ class Request:
     ``max_new_tokens`` always bounds it; the engine's ``max_seq`` caps
     prompt + generation regardless. ``deadline`` (absolute monotonic
     time, or None) retires the request with ``timeout`` wherever it is
-    — queued or mid-decode — once passed."""
+    — queued or mid-decode — once passed. ``trace_id`` is the W3C
+    trace-context id the gateway threaded in (None = untraced): it
+    keys the request's lifecycle spans on the tracer's async track.
+    ``admit_time`` is stamped when the request enters a slot —
+    ``queue_wait_s`` on the result derives from it."""
 
     request_id: int
     prompt: List[int]
@@ -98,6 +103,8 @@ class Request:
     seed: int = 0
     submit_time: float = field(default_factory=time.monotonic)
     deadline: Optional[float] = None
+    trace_id: Optional[str] = None
+    admit_time: Optional[float] = None
 
 
 @dataclass
@@ -116,6 +123,12 @@ class RequestResult:
     detail: Optional[str] = None    # non-ok outcomes: what happened
     ttft_s: Optional[float] = None  # submit -> first generated token
     latency_s: Optional[float] = None
+    # request-scoped latency attribution (additive; the gateway's
+    # access records and per-tenant histograms read these):
+    queue_wait_s: Optional[float] = None   # submit -> slot admission
+    prefill_s: Optional[float] = None      # its admission's prefill wall
+    prefix_hit: bool = False               # radix prefix pages shared
+    trace_id: Optional[str] = None
 
 
 @dataclass
@@ -149,12 +162,28 @@ class EngineMetrics:
     ttft_count: int = 0
     outcomes: Dict[str, int] = field(
         default_factory=lambda: {o: 0 for o in TERMINAL_OUTCOMES})
+    # request-scoped latency distributions (telemetry/histogram.py):
+    # one log-bucketed histogram per metric, fed on the host paths that
+    # already exist (no device sync) — mean_ttft_s above is the legacy
+    # running mean, these are where the tails live. ``snapshot()``
+    # stays flat numeric; readers wanting distributions use
+    # ``histogram_state()`` (live snapshots, replica aggregation).
+    hist: Dict[str, LogHistogram] = field(default_factory=lambda: {
+        name: LogHistogram()
+        for name in ("ttft", "tpot", "queue_wait", "prefill", "e2e")})
     _window_start: float = field(default_factory=time.monotonic)
     _window_tokens: int = 0
 
     def record_ttft(self, ttft_s: float) -> None:
         self.ttft_sum_s += ttft_s
         self.ttft_count += 1
+        self.hist["ttft"].observe(ttft_s)
+
+    def histogram_state(self) -> Dict[str, Dict]:
+        """Sparse JSON form of every latency histogram (the
+        ``latency_histograms`` JSONL record shape, unlabeled)."""
+        return {name: h.to_dict() for name, h in self.hist.items()
+                if h.count}
 
     def record_outcome(self, outcome: str) -> None:
         self.outcomes[outcome] += 1
@@ -208,7 +237,8 @@ class EngineMetrics:
 class _Slot:
     """Host-side state of one decode slot."""
 
-    __slots__ = ("request", "tokens", "position", "generated", "first_token_t")
+    __slots__ = ("request", "tokens", "position", "generated",
+                 "first_token_t", "last_token_t", "prefill_s", "prefix_hit")
 
     def __init__(self) -> None:
         self.request: Optional[Request] = None
@@ -216,6 +246,9 @@ class _Slot:
         self.position = 0        # absolute position of the NEXT token to feed
         self.generated = 0
         self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None  # TPOT inter-arrival
+        self.prefill_s: Optional[float] = None     # its admission's prefill
+        self.prefix_hit = False                    # radix pages shared
 
     @property
     def active(self) -> bool:
@@ -508,6 +541,18 @@ class InferenceEngine:
             return NOOP_SPAN
         return self.tracer.span(name, **args)
 
+    def _req_event(self, ph: str, req: Request, name: str, **args) -> None:
+        """Request-scoped async span event (``ph`` in 'b'/'e'/'n') on
+        the request's trace_id track — one branch when untraced or the
+        tracer is off. The lifecycle vocabulary (req.queued /
+        req.admitted / req.prefill / req.decode / req.finalize) shares
+        the tick loop's phase names, so one Perfetto load correlates a
+        request's track with the per-thread phase spans by eye AND by
+        trace_id."""
+        if self.tracer is None or req.trace_id is None:
+            return
+        self.tracer.async_event(ph, name, req.trace_id, **args)
+
     def _export_key(self):
         """Progress fingerprint for JSONL export dedup (counters only —
         snapshot() itself has wall-clock-derived rates that differ on
@@ -549,12 +594,16 @@ class InferenceEngine:
         eos_id: Optional[int] = None,
         seed: int = 0,
         ttl_s: Optional[float] = None,
+        trace_id: Optional[str] = None,
     ) -> int:
         """Queue a request; returns its id. Admission happens inside
         ``step()`` when a slot frees up.
 
         ``ttl_s`` sets this request's deadline (None = engine
-        ``default_ttl_s``; <= 0 = no deadline). Invalid submissions
+        ``default_ttl_s``; <= 0 = no deadline). ``trace_id`` (a W3C
+        trace-context id, threaded in by the serving gateway) keys this
+        request's lifecycle spans on the tracer's async track and rides
+        the terminal result. Invalid submissions
         raise (``strict_submit=True``, the default) or end as a
         ``rejected`` terminal result; submitting into a draining engine
         raises ``EngineDraining`` / rejects the same way. A full queue
@@ -592,11 +641,14 @@ class InferenceEngine:
         req = Request(
             request_id=next(self._ids), prompt=list(prompt),
             max_new_tokens=max_new_tokens, eos_id=eos_id, seed=seed,
+            trace_id=trace_id,
         )
         ttl = self.default_ttl_s if ttl_s is None else ttl_s
         if ttl and ttl > 0:
             req.deadline = req.submit_time + ttl
         self.metrics.requests_submitted += 1
+        self._req_event("b", req, "request", request_id=req.request_id)
+        self._req_event("b", req, "req.queued")
         if err is not None:
             self._finalize(req, "rejected", tokens=[], detail=err,
                            now=time.monotonic())
@@ -622,11 +674,18 @@ class InferenceEngine:
         reason: Optional[str] = None,
         detail: Optional[str] = None,
         ttft_t: Optional[float] = None,
+        prefill_s: Optional[float] = None,
+        prefix_hit: bool = False,
         now: float,
     ) -> None:
         """Record the single terminal result of ``req``. Every request
         path funnels through here, so the conservation invariant
-        (submitted == sum over outcomes) holds by construction."""
+        (submitted == sum over outcomes) holds by construction — and so
+        do the request's lifecycle-span close and its e2e-latency
+        histogram observation."""
+        latency = now - req.submit_time
+        queue_wait = (req.admit_time - req.submit_time
+                      if req.admit_time is not None else None)
         self._results[req.request_id] = RequestResult(
             request_id=req.request_id,
             prompt=req.prompt,
@@ -635,8 +694,26 @@ class InferenceEngine:
             outcome=outcome,
             detail=detail,
             ttft_s=(ttft_t - req.submit_time) if ttft_t is not None else None,
-            latency_s=now - req.submit_time,
+            latency_s=latency,
+            queue_wait_s=queue_wait,
+            prefill_s=prefill_s,
+            prefix_hit=prefix_hit,
+            trace_id=req.trace_id,
         )
+        if req.admit_time is not None and outcome in ("ok", "timeout"):
+            # only SERVED requests feed the e2e histogram (the same
+            # outcome set as serving/slo.py's LATENCY_OUTCOMES, not
+            # imported — serving sits above inference): an instant
+            # reject's near-zero latency and a client-cancelled slot's
+            # truncated one would both drag the tail estimate down
+            # exactly when overload makes served traffic slowest
+            self.metrics.hist["e2e"].observe(latency)
+        self._req_event(
+            "e", req, "req.decode" if req.admit_time is not None
+            else "req.queued")
+        self._req_event("n", req, "req.finalize", outcome=outcome,
+                        finish_reason=reason or outcome)
+        self._req_event("e", req, "request", outcome=outcome)
         self._finished_tick.append(self._results[req.request_id])
         self.metrics.record_outcome(outcome)
         if outcome != "ok":
@@ -660,7 +737,8 @@ class InferenceEngine:
         req = slot.request
         self._finalize(
             req, outcome, tokens=slot.tokens[len(req.prompt):],
-            reason=reason, detail=detail, ttft_t=slot.first_token_t, now=now,
+            reason=reason, detail=detail, ttft_t=slot.first_token_t,
+            prefill_s=slot.prefill_s, prefix_hit=slot.prefix_hit, now=now,
         )
         slot.request = None
         slot.tokens = []
@@ -770,6 +848,14 @@ class InferenceEngine:
         slot.position = len(req.prompt)
         slot.generated = 0
         slot.first_token_t = None
+        slot.last_token_t = None
+        slot.prefill_s = None
+        slot.prefix_hit = False
+        req.admit_time = time.monotonic()
+        self.metrics.hist["queue_wait"].observe(
+            req.admit_time - req.submit_time)
+        self._req_event("e", req, "req.queued")
+        self._req_event("n", req, "req.admitted", slot=i)
         self._base_keys[i] = np.asarray(
             jax.random.PRNGKey(req.seed), np.uint32)
         self.metrics.requests_admitted += 1
@@ -791,6 +877,9 @@ class InferenceEngine:
             lengths[i] = len(req.prompt)
             write_mask[i] = True
             admitted.append(i)
+        t0 = time.monotonic()
+        for i in admitted:
+            self._req_event("b", self._slots[i].request, "req.prefill")
         with self._span("prefill", admitted=len(admitted)):
             first, _logits, finite, self.cache = self._prefill(
                 self.params, jnp.asarray(tokens), jnp.asarray(lengths),
@@ -798,9 +887,10 @@ class InferenceEngine:
                 jnp.asarray(self._base_keys),
             )
         self.metrics.prefill_calls += 1
-        now = time.monotonic()
         first = np.asarray(first)
         finite = np.asarray(finite)
+        now = time.monotonic()
+        self._note_prefill(admitted, now - t0)
         poisoned = [i for i in admitted if not finite[i]]
         if poisoned:
             self._quarantine(poisoned, now, where="prefill")
@@ -808,6 +898,18 @@ class InferenceEngine:
             if finite[i]:
                 self._emit(i, int(first[i]), now)
         self.metrics.queue_depth = len(self._queue)
+
+    def _note_prefill(self, admitted: List[int], prefill_s: float) -> None:
+        """Attribute one batched prefill's wall time to every request it
+        admitted (they shared the call), close their ``req.prefill``
+        spans and open ``req.decode`` — BEFORE any quarantine retires a
+        poisoned slot, so every begun span gets its end."""
+        for i in admitted:
+            slot = self._slots[i]
+            slot.prefill_s = prefill_s
+            self.metrics.hist["prefill"].observe(prefill_s)
+            self._req_event("e", slot.request, "req.prefill")
+            self._req_event("b", slot.request, "req.decode")
 
     def _reserve_pages(self, req: Request):
         """Try to reserve the pages one request needs: radix-match its
@@ -872,9 +974,14 @@ class InferenceEngine:
             if shared:
                 self.metrics.prefix_hits += 1
                 self.metrics.prefill_tokens_saved += shared
+                self._slots[i].prefix_hit = True
             admitted.append(i)
         if not admitted:
             return
+        t0 = time.monotonic()
+        for i in admitted:
+            self._req_event("b", self._slots[i].request, "req.prefill",
+                            prefix_hit=self._slots[i].prefix_hit)
         with self._span("prefill", admitted=len(admitted)):
             first, _logits, finite, self.cache = self._prefill(
                 self.params, jnp.asarray(tokens), jnp.asarray(tail_lens),
@@ -883,9 +990,10 @@ class InferenceEngine:
                 jnp.asarray(self._base_keys),
             )
         self.metrics.prefill_calls += 1
-        now = time.monotonic()
         first = np.asarray(first)
         finite = np.asarray(finite)
+        now = time.monotonic()
+        self._note_prefill(admitted, now - t0)
         poisoned = [i for i in admitted if not finite[i]]
         if poisoned:
             # skip radix registration for poison prompts — their pages
@@ -924,6 +1032,11 @@ class InferenceEngine:
         if slot.first_token_t is None:
             slot.first_token_t = now
             self.metrics.record_ttft(now - req.submit_time)
+        else:
+            # per-token inter-arrival (TPOT): decode cadence as the
+            # client experiences it, first token (prefill) excluded
+            self.metrics.hist["tpot"].observe(now - slot.last_token_t)
+        slot.last_token_t = now
         if self.on_tokens is not None:
             # push the newly sampled token to the streaming bridge BEFORE
             # any stop condition retires the slot — the stream sees every

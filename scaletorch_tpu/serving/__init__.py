@@ -49,6 +49,15 @@ _EXPORTS = {
     "ProtocolError": "protocol",
     "parse_generate_request": "protocol",
     "parse_sse_stream": "protocol",
+    "parse_traceparent": "protocol",
+    "make_traceparent": "protocol",
+    "new_trace_id": "protocol",
+    "new_span_id": "protocol",
+    # slo (stdlib)
+    "load_slo": "slo",
+    "preset_targets": "slo",
+    "evaluate_slo": "slo",
+    "format_report": "slo",
     # router (pulls the framework logger)
     "NoReplicaAvailable": "router",
     "PrefixAwareRouter": "router",

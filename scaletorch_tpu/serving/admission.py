@@ -325,6 +325,21 @@ class AdmissionController:
         self.max_engine_queue = max_engine_queue
         self.on_shed = on_shed
         self.shed_count = 0
+        # which tenant each shed was charged to (the arrival's tenant,
+        # or the over-share tenant a fairness eviction displaced) — the
+        # gateway exposes this as a tenant-labeled counter so "who is
+        # being shed?" is answerable from /metrics, not just the total
+        self.shed_by_tenant: Dict[str, int] = {}
+
+    def _count_shed(self, tenant: str) -> None:
+        self.shed_count += 1
+        # tenant names are untrusted client strings: cap the counter's
+        # cardinality (rotating random tenants must not grow gateway
+        # memory); over-cap attribution coarsens to "_other"
+        if tenant not in self.shed_by_tenant \
+                and len(self.shed_by_tenant) >= 64:
+            tenant = "_other"
+        self.shed_by_tenant[tenant] = self.shed_by_tenant.get(tenant, 0) + 1
 
     # -- arrival side ------------------------------------------------------
     def offer(self, tenant: str, item: Any,
@@ -346,14 +361,14 @@ class AdmissionController:
                     reason=f"request cost {cost:g} exceeds tenant "
                            f"{tenant!r}'s burst capacity",
                     retry_after_s=retry_after, outcome="rejected")
-            self.shed_count += 1
+            self._count_shed(tenant)
             return SheddingDecision(
                 reason=f"tenant {tenant!r} over its rate limit",
                 retry_after_s=retry_after)
         if len(self.queue) >= self.max_backlog:
             decision = self._arbitrate_full_backlog(tenant)
             if decision is not None:
-                self.shed_count += 1
+                self._count_shed(tenant)
                 return decision
             # an over-share victim was just evicted to make room for
             # THIS arrival — shedding the arrival too (pool gate) would
@@ -363,7 +378,7 @@ class AdmissionController:
         if len(self.queue) > 0 and self._pool_saturated():
             # a backlog already exists AND the page pool is under the
             # free watermark: more queueing can only turn into timeouts
-            self.shed_count += 1
+            self._count_shed(tenant)
             return SheddingDecision(
                 reason="page pool under the free watermark with a "
                        "standing backlog",
@@ -397,7 +412,7 @@ class AdmissionController:
             return SheddingDecision(
                 reason=f"gateway backlog at capacity ({self.max_backlog})",
                 retry_after_s=self._drain_eta())
-        self.shed_count += 1
+        self._count_shed(over)
         decision = SheddingDecision(
             reason=f"shed for tenant fairness: {over!r} over its backlog "
                    f"share while the queue is at capacity",

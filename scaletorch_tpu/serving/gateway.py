@@ -13,13 +13,26 @@ Three endpoints:
     tokens, then exactly one ``done`` event carrying the PR 7 terminal
     outcome. With ``stream: false`` a single JSON body whose HTTP
     status IS the outcome (``protocol.STATUS_BY_OUTCOME``).
-  * ``GET /metrics`` — Prometheus text exposition via the PR 8
-    ``telemetry.export`` renderer: gateway gauges (per-tenant queue
-    depth, shed/429 counts, SSE streams open, router prefix-hit rate)
-    merged with each replica's live ``EngineMetrics`` snapshot
-    (prefixed ``replica_<id>_``).
-  * ``GET /healthz`` — liveness + capacity: per-replica alive flags and
-    the page-pool headroom gauges admission is actually steering by.
+  * ``GET /metrics`` — Prometheus text exposition via
+    ``telemetry.export.render_families``: gateway counters/gauges,
+    tenant-labeled queue depths and shed counters, each replica's live
+    ``EngineMetrics`` snapshot as ``engine_*{replica="..."}`` series,
+    and the per-tenant latency distributions (TTFT, TPOT, queue wait,
+    prefill, e2e) as real ``histogram`` families — identities ride
+    escaped LABELS, never the metric name. HTTP/1.1 keep-alive, so a
+    scrape-heavy Prometheus pays one connection, not one per scrape.
+  * ``GET /healthz`` — liveness + capacity: per-replica alive flags,
+    the page-pool headroom gauges admission is actually steering by,
+    and (when SLO targets are configured) a live ``slo`` verdict.
+    Keep-alive like /metrics.
+
+Request-scoped observability: the gateway accepts/mints a W3C
+``traceparent`` per generate request, emits gateway-side spans
+(``gw.parse`` plus async ``gw.request``/``gw.queued``/``gw.stream``
+events keyed by trace id), threads the trace id through the worker
+bridge into the engine's lifecycle spans, records per-tenant latency
+histograms, and writes one ``access`` JSONL record per terminal
+outcome.
 
 The sync/async seam is ``EngineWorker``: the engine is synchronous and
 single-threaded by design (one jitted decode step, one compile), so each
@@ -75,7 +88,10 @@ from scaletorch_tpu.serving.router import (
     NoReplicaAvailable,
     PrefixAwareRouter,
 )
-from scaletorch_tpu.telemetry.export import render_prometheus
+from scaletorch_tpu.serving.slo import LATENCY_OUTCOMES, evaluate_slo
+from scaletorch_tpu.telemetry.export import render_families
+from scaletorch_tpu.telemetry.histogram import TenantHistograms
+from scaletorch_tpu.telemetry.spans import NOOP_SPAN
 from scaletorch_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -90,6 +106,11 @@ _REASONS = {
 MAX_BODY_BYTES = 8 * 2**20
 MAX_HEADER_LINES = 100
 HEADER_TIMEOUT_S = 30.0
+
+# The per-tenant latency distributions the gateway records
+# (telemetry/histogram.py): time-to-first-token, per-token
+# inter-arrival, WFQ queue wait, engine prefill wall, end-to-end.
+HIST_METRICS = ("ttft", "tpot", "queue_wait", "prefill", "e2e")
 
 
 # --------------------------------------------------------------------------
@@ -197,7 +218,8 @@ class EngineWorker:
             try:
                 rid = self.engine.submit(
                     req.prompt, max_new_tokens=req.max_new_tokens,
-                    eos_id=req.eos_id, seed=req.seed, ttl_s=ttl_s)
+                    eos_id=req.eos_id, seed=req.seed, ttl_s=ttl_s,
+                    trace_id=req.trace_id)
             except Exception as exc:
                 on_done(RequestResult(
                     request_id=-1, prompt=list(req.prompt), tokens=[],
@@ -407,14 +429,23 @@ class GatewayMetrics:
 
 
 class _Pending:
-    """Event-loop-side state of one generate request."""
+    """Event-loop-side state of one generate request, including its
+    request-scoped observability state: the W3C trace id, the gateway
+    timeline stamps (arrival / WFQ enqueue / dispatch / token arrivals)
+    the per-tenant histograms and the access record derive from, and
+    the engine's terminal ``RequestResult`` once it lands."""
 
     __slots__ = ("req", "chan", "request_id", "replica_id", "cancelled",
-                 "deadline", "synthetic")
+                 "deadline", "synthetic", "trace_id", "parent_span",
+                 "arrival_t", "enqueue_t", "dispatch_t", "first_token_t",
+                 "last_token_t", "token_count", "result")
 
     def __init__(self, req: GenerateRequest, *,
                  deadline: Optional[float],
-                 synthetic: bool = False) -> None:
+                 synthetic: bool = False,
+                 trace_id: Optional[str] = None,
+                 parent_span: Optional[str] = None,
+                 arrival_t: Optional[float] = None) -> None:
         self.req = req
         self.chan: "asyncio.Queue[Tuple[str, Any]]" = asyncio.Queue()
         self.request_id: Optional[int] = None
@@ -422,6 +453,16 @@ class _Pending:
         self.cancelled: Optional[str] = None  # outcome it was closed with
         self.deadline = deadline
         self.synthetic = synthetic
+        self.trace_id = trace_id
+        self.parent_span = parent_span
+        self.arrival_t = arrival_t if arrival_t is not None \
+            else time.monotonic()
+        self.enqueue_t: Optional[float] = None
+        self.dispatch_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.token_count = 0
+        self.result: Optional[RequestResult] = None
 
 
 class ServingGateway:
@@ -442,9 +483,21 @@ class ServingGateway:
     injector : optional ``ServingFaultInjector`` driving the gateway
         drills (``gw_tenant_storm_*``, ``gw_replica_down_at``).
     exporter : optional ``telemetry.TelemetryExporter``; the gateway
-        appends ``gateway_metrics`` JSONL records every
-        ``export_every`` terminal responses and at shutdown — the same
-        schema-versioned stream the trainer and engine write.
+        appends ``gateway_metrics`` + ``latency_histograms`` JSONL
+        records every ``export_every`` terminal responses and at
+        shutdown, plus one ``access`` record per terminal HTTP outcome
+        (tenant, outcome, status, trace_id, queue_wait/ttft/e2e,
+        tokens, prefix_hit, replica) — the same schema-versioned
+        stream the trainer and engine write.
+    tracer : optional ``telemetry.SpanTracer`` (share ONE instance with
+        the engines — scripts/serve.py does): the gateway emits
+        ``gw.parse`` spans plus per-request async events (``gw.request``
+        / ``gw.queued`` / ``gw.stream``) keyed by the W3C trace id, so
+        a single Perfetto load shows one request crossing the asyncio
+        thread, the worker bridge and the engine tick loop.
+    slo_targets : optional preset spec from tools/slo.json
+        (``serving.slo``); when set, ``/healthz`` carries a live
+        ``slo`` block graded from the in-process histograms/outcomes.
     """
 
     def __init__(
@@ -463,6 +516,8 @@ class ServingGateway:
         injector: Optional[ServingFaultInjector] = None,
         exporter: Any = None,
         export_every: int = 32,
+        tracer: Any = None,
+        slo_targets: Optional[Dict[str, Any]] = None,
     ) -> None:
         if isinstance(engines, (InferenceEngine, EngineWorker)):
             engines = {"r0": engines}
@@ -493,6 +548,9 @@ class ServingGateway:
                 pending, "shed", decision.reason),
         )
         self.metrics = GatewayMetrics()
+        self.hists = TenantHistograms(HIST_METRICS)
+        self.tracer = tracer
+        self.slo_targets = slo_targets
         self.default_ttl_s = default_ttl_s
         self.injector = injector
         self.exporter = exporter
@@ -533,6 +591,23 @@ class ServingGateway:
         if not saw:
             agg["queue_depth"] = float("inf")
         return agg
+
+    # -- tracing -----------------------------------------------------------
+    def _span(self, name: str, **args):
+        """Complete-event span on the gateway (asyncio) thread; shared
+        no-op when untraced — the engine's one-branch contract."""
+        if self.tracer is None:
+            return NOOP_SPAN
+        return self.tracer.span(name, **args)
+
+    def _req_event(self, ph: str, trace_id: Optional[str], name: str,
+                   **args) -> None:
+        """Request-scoped async event on the trace_id track (same
+        Chrome async-event surface the engine's lifecycle spans use, so
+        gateway-side and engine-side spans correlate by id)."""
+        if self.tracer is None or trace_id is None:
+            return
+        self.tracer.async_event(ph, name, trace_id, **args)
 
     # -- lifecycle ---------------------------------------------------------
     async def start(self) -> "ServingGateway":
@@ -715,7 +790,8 @@ class ServingGateway:
                         "deadline exceeded in the gateway queue")
                     continue
                 try:
-                    replica_id = self.router.route(pending.req.prompt)
+                    with self._span("gw.route"):
+                        replica_id = self.router.route(pending.req.prompt)
                 except NoReplicaAvailable:
                     self._finish_local(pending, "rejected",
                                        "no healthy replica")
@@ -748,6 +824,10 @@ class ServingGateway:
     def _submit_to(self, worker: EngineWorker, replica_id: str,
                    pending: _Pending) -> None:
         pending.replica_id = replica_id
+        pending.dispatch_t = time.monotonic()
+        self._req_event("e", pending.trace_id, "gw.queued")
+        self._req_event("b", pending.trace_id, "gw.stream",
+                        replica=replica_id)
         loop = self._loop
         chan = pending.chan
 
@@ -780,12 +860,86 @@ class ServingGateway:
         pending.cancelled = outcome
         pending.chan.put_nowait(("local", (outcome, detail)))
 
+    def _finish_unqueued(self, outcome: str, status: int,
+                         trace_id: Optional[str], tenant: str,
+                         arrival_t: float) -> None:
+        """Terminal a request refused BEFORE admission (parse failure,
+        draining gateway) through the same bookkeeping point as every
+        other outcome — the access log and span close cover 400s too."""
+        req = GenerateRequest(prompt=[], tenant=tenant, stream=False,
+                              trace_id=trace_id)
+        pending = _Pending(req, deadline=None, trace_id=trace_id,
+                           arrival_t=arrival_t)
+        self._record_outcome(pending, outcome, status)
+
     def _record_outcome(self, pending: _Pending, outcome: str,
                         status: int) -> None:
+        """The single per-request terminal bookkeeping point: outcome
+        counters, per-tenant latency histograms, the ``access`` JSONL
+        record, and the request's gateway-span close."""
         if pending.synthetic:
             self.metrics.storm_outcomes[outcome] += 1
-        else:
-            self.metrics.record_response(outcome, status)
+            return
+        self.metrics.record_response(outcome, status)
+        now = time.monotonic()
+        tenant = pending.req.tenant
+        result = pending.result
+        # only SERVED outcomes feed the SLO latency quantiles
+        # (slo.LATENCY_OUTCOMES): a shed/rejected refusal terminates in
+        # microseconds, and folding those into the histograms would drag
+        # p99 down exactly when overload makes served traffic slowest.
+        # TTFT/TPOT are observed at token arrival (served by
+        # definition); the access record keeps every timing regardless.
+        served = outcome in LATENCY_OUTCOMES
+        queue_wait = None
+        if pending.enqueue_t is not None:
+            # WFQ wait: enqueue -> dispatch, or -> terminal when it
+            # never dispatched (timed out / shed / drained in the queue)
+            queue_wait = (pending.dispatch_t or now) - pending.enqueue_t
+            if served:
+                self.hists.observe("queue_wait", tenant, queue_wait)
+            if pending.dispatch_t is None:
+                self._req_event("e", pending.trace_id, "gw.queued",
+                                outcome=outcome)
+        ttft = None
+        if pending.first_token_t is not None:
+            ttft = pending.first_token_t - pending.arrival_t
+        e2e = now - pending.arrival_t
+        if served:
+            self.hists.observe("e2e", tenant, e2e)
+            if result is not None and result.prefill_s is not None:
+                self.hists.observe("prefill", tenant, result.prefill_s)
+        if pending.dispatch_t is not None:
+            self._req_event("e", pending.trace_id, "gw.stream",
+                            outcome=outcome)
+        self._req_event("e", pending.trace_id, "gw.request",
+                        outcome=outcome, status=status)
+        if self.exporter is not None:
+            record = {
+                "tenant": tenant,
+                "outcome": outcome,
+                "status": status,
+                "trace_id": pending.trace_id,
+                "request_id": pending.request_id,
+                "replica": pending.replica_id,
+                "stream": pending.req.stream,
+                "prompt_tokens": len(pending.req.prompt),
+                "tokens": pending.token_count,
+                "queue_wait_s": queue_wait,
+                "engine_queue_wait_s": (
+                    result.queue_wait_s if result is not None else None),
+                "prefill_s": (
+                    result.prefill_s if result is not None else None),
+                "ttft_s": ttft,
+                "e2e_s": e2e,
+                "prefix_hit": (
+                    bool(result.prefix_hit) if result is not None
+                    else False),
+            }
+            try:
+                self.exporter.emit("access", record)
+            except Exception:
+                logger.exception("access record export failed")
         self._responses_since_export += 1
         if self.exporter is not None and \
                 self._responses_since_export >= self.export_every:
@@ -797,6 +951,9 @@ class ServingGateway:
         self._responses_since_export = 0
         try:
             self.exporter.emit("gateway_metrics", self.snapshot())
+            hist_record = self.hists.to_record()
+            if hist_record:
+                self.exporter.emit("latency_histograms", hist_record)
         except Exception:
             logger.exception("gateway metrics export failed")
 
@@ -813,23 +970,42 @@ class ServingGateway:
     async def _handle_connection(self, reader: asyncio.StreamReader,
                                  writer: asyncio.StreamWriter) -> None:
         try:
-            request = await self._read_request(reader)
-            if request is None:
-                return
-            method, path, headers, body = request
-            if path.split("?")[0] == "/v1/generate":
-                if method != "POST":
-                    await self._respond_json(
-                        writer, 405, {"detail": "POST only"})
+            # HTTP/1.1 keep-alive on the read-only endpoints: a
+            # scrape-heavy Prometheus consumer polls /metrics (and a
+            # load balancer /healthz) every few seconds, and paying a
+            # TCP handshake per scrape is pure overhead (ROADMAP
+            # front-door item). Generate requests keep one-shot
+            # connections — an SSE stream owns its socket until the
+            # terminal event anyway.
+            while True:
+                request = await self._read_request(reader)
+                if request is None:
                     return
-                await self._handle_generate(reader, writer, headers, body)
-            elif path.split("?")[0] in ("/metrics", "/metrics/"):
-                await self._handle_metrics(writer)
-            elif path.split("?")[0] in ("/healthz", "/healthz/"):
-                await self._handle_healthz(writer)
-            else:
-                await self._respond_json(
-                    writer, 404, {"detail": f"no route {path!r}"})
+                method, path, headers, body = request
+                route = path.split("?")[0]
+                if route == "/v1/generate":
+                    if method != "POST":
+                        await self._respond_json(
+                            writer, 405, {"detail": "POST only"})
+                        return
+                    await self._handle_generate(reader, writer, headers,
+                                                body)
+                    return
+                keep_alive = (
+                    headers.get("connection", "").lower() != "close"
+                    and not self._closing)
+                if route in ("/metrics", "/metrics/"):
+                    await self._handle_metrics(writer,
+                                               keep_alive=keep_alive)
+                elif route in ("/healthz", "/healthz/"):
+                    await self._handle_healthz(writer,
+                                               keep_alive=keep_alive)
+                else:
+                    await self._respond_json(
+                        writer, 404, {"detail": f"no route {path!r}"})
+                    return
+                if not keep_alive:
+                    return
         except (ConnectionError, asyncio.IncompleteReadError,
                 asyncio.TimeoutError):
             pass
@@ -889,30 +1065,89 @@ class ServingGateway:
     async def _respond_json(self, writer: asyncio.StreamWriter, status: int,
                             payload: Dict[str, Any],
                             extra_headers: Tuple[Tuple[str, str], ...] = (),
-                            ) -> None:
+                            keep_alive: bool = False) -> None:
         body = json.dumps(payload).encode()
         head = [f"HTTP/1.1 {status} {_REASONS.get(status, 'Unknown')}",
                 "Content-Type: application/json",
                 f"Content-Length: {len(body)}",
-                "Connection: close"]
+                f"Connection: {'keep-alive' if keep_alive else 'close'}"]
         head += [f"{k}: {v}" for k, v in extra_headers]
         writer.write(("\r\n".join(head) + "\r\n\r\n").encode() + body)
         await writer.drain()
 
-    async def _handle_metrics(self, writer: asyncio.StreamWriter) -> None:
-        merged = dict(self.snapshot())
+    def metric_families(self) -> List[Dict[str, Any]]:
+        """The /metrics exposition as structured families: unlabeled
+        gateway counters/gauges (names unchanged since PR 11), tenant-
+        and replica-labeled series where an identity is involved —
+        labels, not name mangling, carry the untrusted strings — and
+        the per-tenant latency distributions as real histogram
+        families."""
+        families: List[Dict[str, Any]] = []
+        base = self.metrics.snapshot(
+            tenant_depths={}, shed_count=self.admission.shed_count,
+            router_snapshot=self.router.snapshot())
+        for key in sorted(base):
+            ftype = "gauge" if key in ("sse_streams_open",) \
+                or key.startswith("router_") else "counter"
+            families.append({"name": key, "type": ftype,
+                             "samples": [(None, base[key])]})
+        families.append({
+            "name": "tenant_queue_depth", "type": "gauge",
+            "samples": [({"tenant": t}, d)
+                        for t, d in sorted(self.admission.depths().items())],
+        })
+        families.append({
+            "name": "gateway_shed_by_tenant", "type": "counter",
+            "samples": [
+                ({"tenant": t}, c) for t, c in
+                sorted(self.admission.shed_by_tenant.items())],
+        })
+        engine_samples: Dict[str, List] = {}
         for rid, worker in self.workers.items():
             for key, value in worker.gauges().items():
-                merged[f"replica_{rid}_{key}"] = value
-        body = render_prometheus(merged).encode()
+                engine_samples.setdefault(key, []).append(
+                    ({"replica": rid}, value))
+        for key in sorted(engine_samples):
+            families.append({"name": f"engine_{key}", "type": "gauge",
+                             "samples": engine_samples[key]})
+        for metric in HIST_METRICS:
+            series = self.hists.series(metric)
+            if not series:
+                continue
+            families.append({
+                "name": f"request_{metric}_seconds", "type": "histogram",
+                "series": [({"tenant": t}, h)
+                           for t, h in sorted(series.items())],
+            })
+        return families
+
+    async def _handle_metrics(self, writer: asyncio.StreamWriter,
+                              keep_alive: bool = False) -> None:
+        body = render_families(self.metric_families()).encode()
         head = ("HTTP/1.1 200 OK\r\n"
                 "Content-Type: text/plain; version=0.0.4\r\n"
                 f"Content-Length: {len(body)}\r\n"
-                "Connection: close\r\n\r\n").encode()
+                "Connection: "
+                f"{'keep-alive' if keep_alive else 'close'}\r\n\r\n"
+                ).encode()
         writer.write(head + body)
         await writer.drain()
 
-    async def _handle_healthz(self, writer: asyncio.StreamWriter) -> None:
+    def slo_status(self) -> Optional[Dict[str, Any]]:
+        """Live SLO verdict from the in-process histograms + outcome
+        counters (None when no targets are configured)."""
+        if self.slo_targets is None:
+            return None
+
+        def quantile(metric: str, q: float) -> Optional[float]:
+            merged = self.hists.merged(metric)
+            return merged.quantile(q) if merged is not None else None
+
+        return evaluate_slo(self.slo_targets, quantile_fn=quantile,
+                            outcomes=self.metrics.outcomes)
+
+    async def _handle_healthz(self, writer: asyncio.StreamWriter,
+                              keep_alive: bool = False) -> None:
         replicas: Dict[str, Any] = {}
         any_alive = False
         for rid, worker in self.workers.items():
@@ -934,7 +1169,11 @@ class ServingGateway:
             "backlog": len(self.admission.queue),
             "replicas": replicas,
         }
-        await self._respond_json(writer, 200 if healthy else 503, payload)
+        slo = self.slo_status()
+        if slo is not None:
+            payload["slo"] = slo
+        await self._respond_json(writer, 200 if healthy else 503, payload,
+                                 keep_alive=keep_alive)
 
     # -- generate ----------------------------------------------------------
     def _inject_tenant_storm(self, count: int) -> None:
@@ -979,42 +1218,63 @@ class ServingGateway:
                                      writer: asyncio.StreamWriter,
                                      headers: Dict[str, str],
                                      body: bytes) -> None:
+        arrival_t = time.monotonic()
         self.metrics.http_requests_received += 1
         arrival_n = self.metrics.http_requests_received
         if self.injector is not None:
             storm = self.injector.take_gw_tenant_storm(arrival_n)
             if storm:
                 self._inject_tenant_storm(storm)
+        # W3C trace context: accept the client's traceparent, mint a
+        # fresh trace otherwise — a malformed header degrades to a new
+        # trace, NEVER an error (fuzz-tested); the response echoes the
+        # trace id with the gateway's span id as the new parent
+        parent = protocol.parse_traceparent(headers.get("traceparent"))
+        trace_id = parent[0] if parent else protocol.new_trace_id()
+        span_id = protocol.new_span_id()
+        traceparent_echo = (
+            ("traceparent", protocol.make_traceparent(trace_id, span_id)),)
+        self._req_event("b", trace_id, "gw.request",
+                        parent_span=parent[1] if parent else None)
         try:
-            req = protocol.parse_generate_request(
-                body, header_tenant=headers.get("x-tenant"))
+            with self._span("gw.parse", bytes=len(body)):
+                req = protocol.parse_generate_request(
+                    body, header_tenant=headers.get("x-tenant"))
         except ProtocolError as exc:
-            self.metrics.record_response(
-                "rejected", protocol.BAD_REQUEST_STATUS)
+            self._finish_unqueued(
+                "rejected", protocol.BAD_REQUEST_STATUS, trace_id,
+                headers.get("x-tenant") or protocol.DEFAULT_TENANT,
+                arrival_t)
             await self._respond_json(
                 writer, protocol.BAD_REQUEST_STATUS,
-                protocol.error_payload(str(exc)))
+                protocol.error_payload(str(exc)),
+                extra_headers=traceparent_echo)
             return
+        req.trace_id = trace_id
         if self._closing:
-            self.metrics.record_response("rejected", 503)
+            self._finish_unqueued("rejected", 503, trace_id, req.tenant,
+                                  arrival_t)
             await self._respond_json(
                 writer, 503,
-                protocol.error_payload("gateway is draining"))
+                protocol.error_payload("gateway is draining"),
+                extra_headers=traceparent_echo)
             return
         ttl = req.ttl_s if req.ttl_s is not None else (
             self.default_ttl_s if self.default_ttl_s > 0 else None)
         deadline = time.monotonic() + ttl if ttl else None
-        pending = _Pending(req, deadline=deadline)
+        pending = _Pending(req, deadline=deadline, trace_id=trace_id,
+                           parent_span=parent[1] if parent else None,
+                           arrival_t=arrival_t)
         shed = self.admission.offer(req.tenant, pending, float(req.cost))
         if shed is not None:
             status = protocol.STATUS_BY_OUTCOME[shed.outcome]
-            extra: Tuple[Tuple[str, str], ...] = ()
+            extra: Tuple[Tuple[str, str], ...] = traceparent_echo
             retry_s = None
             if shed.outcome == "shed":  # backing off helps: say how long
                 retry_s = shed.retry_after_s
-                extra = (("Retry-After",
-                          str(max(1, int(round(retry_s))))),)
-            self.metrics.record_response(shed.outcome, status)
+                extra = extra + (("Retry-After",
+                                  str(max(1, int(round(retry_s))))),)
+            self._record_outcome(pending, shed.outcome, status)
             await self._respond_json(
                 writer, status,
                 protocol.error_payload(
@@ -1022,11 +1282,14 @@ class ServingGateway:
                     retry_after_s=retry_s),
                 extra_headers=extra)
             return
+        pending.enqueue_t = time.monotonic()
+        self._req_event("b", trace_id, "gw.queued", tenant=req.tenant)
         self._wake.set()
         if req.stream:
-            await self._stream_response(reader, writer, pending)
+            await self._stream_response(reader, writer, pending,
+                                        traceparent_echo)
         else:
-            await self._unary_response(writer, pending)
+            await self._unary_response(writer, pending, traceparent_echo)
 
     async def _await_terminal(
         self, pending: _Pending,
@@ -1061,6 +1324,7 @@ class ServingGateway:
                         pending.request_id = payload[0]
                     elif kind == "done":
                         pending.cancelled = "aborted"  # client gone
+                        pending.result = payload
                         return "aborted", \
                             protocol.STATUS_BY_OUTCOME["aborted"], \
                             protocol.result_payload(
@@ -1068,7 +1332,8 @@ class ServingGateway:
                                 finish_reason="aborted",
                                 token_ids=list(payload.tokens),
                                 prompt_tokens=len(req.prompt),
-                                detail=detail)
+                                detail=detail,
+                                trace_id=pending.trace_id)
                 else:
                     get.cancel()
                 self._cancel_disconnected(pending, detail)
@@ -1078,18 +1343,32 @@ class ServingGateway:
                         else -1,
                         outcome="aborted", finish_reason="aborted",
                         token_ids=[], prompt_tokens=len(req.prompt),
-                        detail=detail)
+                        detail=detail, trace_id=pending.trace_id)
             kind, payload = get.result()
             if kind == "submitted":
                 pending.request_id = payload
             elif kind == "tokens":
                 rid, token_ids = payload
                 pending.request_id = rid
+                # token-arrival stamps as the CLIENT experiences them —
+                # TTFT/TPOT measured at the event loop, after the
+                # worker-bridge trampoline, per tenant
+                now = time.monotonic()
+                if pending.first_token_t is None:
+                    pending.first_token_t = now
+                    self.hists.observe(
+                        "ttft", req.tenant, now - pending.arrival_t)
+                elif pending.last_token_t is not None:
+                    self.hists.observe(
+                        "tpot", req.tenant, now - pending.last_token_t)
+                pending.last_token_t = now
+                pending.token_count += len(token_ids)
                 if on_tokens is not None:
                     await on_tokens(rid, token_ids)
             elif kind == "done":
                 result: RequestResult = payload
                 pending.request_id = result.request_id
+                pending.result = result
                 return result.outcome, \
                     protocol.STATUS_BY_OUTCOME[result.outcome], \
                     protocol.result_payload(
@@ -1098,14 +1377,15 @@ class ServingGateway:
                         finish_reason=result.finish_reason,
                         token_ids=list(result.tokens),
                         prompt_tokens=len(req.prompt),
-                        detail=result.detail)
+                        detail=result.detail,
+                        trace_id=pending.trace_id)
             elif kind == "local":
                 outcome, detail = payload
                 return outcome, protocol.STATUS_BY_OUTCOME[outcome], \
                     protocol.result_payload(
                         -1, outcome=outcome, finish_reason=outcome,
                         token_ids=[], prompt_tokens=len(req.prompt),
-                        detail=detail)
+                        detail=detail, trace_id=pending.trace_id)
 
     async def _reap_disconnected(self, pending: _Pending,
                                  detail: str) -> None:
@@ -1129,21 +1409,25 @@ class ServingGateway:
                 self.workers[pending.replica_id].cancel(rid, detail)
 
     async def _unary_response(self, writer: asyncio.StreamWriter,
-                              pending: _Pending) -> None:
+                              pending: _Pending,
+                              extra_headers: Tuple[Tuple[str, str], ...] = (),
+                              ) -> None:
         outcome, status, payload = await self._await_terminal(pending)
         self._record_outcome(pending, outcome, status)
-        extra: Tuple[Tuple[str, str], ...] = ()
+        extra = extra_headers
         if outcome == "shed":
             # every 429 carries a Retry-After, including fairness
             # evictions decided after this arrival was queued
-            extra = (("Retry-After", str(max(1, int(round(
+            extra = extra + (("Retry-After", str(max(1, int(round(
                 self.admission.retry_after_hint()))))),)
         await self._respond_json(writer, status, payload,
                                  extra_headers=extra)
 
     async def _stream_response(self, reader: asyncio.StreamReader,
                                writer: asyncio.StreamWriter,
-                               pending: _Pending) -> None:
+                               pending: _Pending,
+                               extra_headers: Tuple[Tuple[str, str], ...] = (),
+                               ) -> None:
         self.metrics.sse_streams_open += 1
         self.metrics.sse_streams_total += 1
         # an SSE client signals disconnect by closing its socket — the
@@ -1151,11 +1435,12 @@ class ServingGateway:
         disconnect = asyncio.ensure_future(self._watch_disconnect(reader))
         recorded = False
         try:
-            writer.write((
-                "HTTP/1.1 200 OK\r\n"
-                "Content-Type: text/event-stream\r\n"
-                "Cache-Control: no-cache\r\n"
-                "Connection: close\r\n\r\n").encode())
+            head = ["HTTP/1.1 200 OK",
+                    "Content-Type: text/event-stream",
+                    "Cache-Control: no-cache",
+                    "Connection: close"]
+            head += [f"{k}: {v}" for k, v in extra_headers]
+            writer.write(("\r\n".join(head) + "\r\n\r\n").encode())
             await writer.drain()
 
             async def _write_tokens(rid: int, token_ids: List[int]) -> None:

@@ -32,6 +32,8 @@ always ends with exactly one ``done``.
 from __future__ import annotations
 
 import json
+import re
+import secrets
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -63,6 +65,69 @@ BAD_REQUEST_STATUS = 400
 DEFAULT_TENANT = "default"
 
 
+# --------------------------------------------------------------------------
+# W3C trace context (traceparent)
+# --------------------------------------------------------------------------
+#
+# The gateway accepts a standard ``traceparent`` request header
+# (https://www.w3.org/TR/trace-context/), threads the 128-bit trace id
+# through the engine as the request's span-correlation key, and echoes
+# a ``traceparent`` response header carrying the same trace id with the
+# gateway's own span id as the new parent. A request without the header
+# — or with a malformed one — gets a FRESH trace id: bad tracing input
+# from a client must degrade to "uncorrelated", never to an error
+# (fuzz-tested in tests/serving/test_protocol.py).
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})"
+    r"(?:-.*)?$")
+
+
+def parse_traceparent(header: Optional[str]) -> Optional[Tuple[str, str]]:
+    """``traceparent`` header -> ``(trace_id, parent_span_id)``, or
+    None for absent/malformed input (the caller mints a fresh trace).
+    Per spec: lowercase hex only, version ``ff`` is invalid, all-zero
+    trace/span ids are invalid, and a version above ``00`` may carry
+    extra ``-``-delimited fields (accepted, ignored) while version
+    ``00`` must have exactly four."""
+    if not header or not isinstance(header, str):
+        return None
+    match = _TRACEPARENT_RE.match(header.strip())
+    if match is None:
+        return None
+    version, trace_id, span_id, _flags = match.groups()
+    if version == "ff":
+        return None
+    if version == "00" and header.strip().count("-") != 3:
+        return None
+    if trace_id == "0" * 32 or span_id == "0" * 16:
+        return None
+    return trace_id, span_id
+
+
+def new_trace_id() -> str:
+    """Random 128-bit lowercase-hex trace id (never all-zero)."""
+    while True:
+        tid = secrets.token_hex(16)
+        if tid != "0" * 32:
+            return tid
+
+
+def new_span_id() -> str:
+    """Random 64-bit lowercase-hex span id (never all-zero)."""
+    while True:
+        sid = secrets.token_hex(8)
+        if sid != "0" * 16:
+            return sid
+
+
+def make_traceparent(trace_id: str, span_id: Optional[str] = None,
+                     *, sampled: bool = True) -> str:
+    """Format a version-00 ``traceparent`` (the response-header echo)."""
+    return (f"00-{trace_id}-{span_id or new_span_id()}-"
+            f"{'01' if sampled else '00'}")
+
+
 class ProtocolError(ValueError):
     """A request that violates the wire schema. ``status`` is the HTTP
     answer — 400 by default, e.g. 413 for an oversized body."""
@@ -82,7 +147,10 @@ class GenerateRequest:
     engine's contract). ``tenant`` scopes fairness/rate limiting (the
     ``x-tenant`` header is the fallback); ``stream`` selects SSE
     streaming (default) vs a single JSON response; ``ttl_s`` is the
-    request deadline (None = the gateway's default).
+    request deadline (None = the gateway's default). ``trace_id`` is
+    NOT a body field: the gateway sets it from the ``traceparent``
+    header (or mints one) and it rides here so the worker bridge can
+    hand it to ``engine.submit``.
     """
 
     prompt: List[int]
@@ -92,6 +160,7 @@ class GenerateRequest:
     ttl_s: Optional[float] = None
     tenant: str = DEFAULT_TENANT
     stream: bool = True
+    trace_id: Optional[str] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     @property
@@ -164,9 +233,12 @@ def parse_generate_request(
 
 def result_payload(request_id: int, *, outcome: str, finish_reason: str,
                    token_ids: List[int], prompt_tokens: int,
-                   detail: Optional[str] = None) -> Dict[str, Any]:
+                   detail: Optional[str] = None,
+                   trace_id: Optional[str] = None) -> Dict[str, Any]:
     """The terminal record of one request — the ``done`` SSE event's
-    data and the whole body of a non-streaming response."""
+    data and the whole body of a non-streaming response. ``trace_id``
+    (additive, v stays 1) lets a client join its response to the
+    server-side trace and access log."""
     return {
         "v": PROTOCOL_VERSION,
         "request_id": request_id,
@@ -174,6 +246,7 @@ def result_payload(request_id: int, *, outcome: str, finish_reason: str,
         "finish_reason": finish_reason,
         "token_ids": token_ids,
         "detail": detail,
+        "trace_id": trace_id,
         "usage": {
             "prompt_tokens": prompt_tokens,
             "completion_tokens": len(token_ids),

@@ -30,6 +30,14 @@ from scaletorch_tpu.telemetry.export import (
     SCHEMA_VERSION,
     PrometheusEndpoint,
     TelemetryExporter,
+    render_families,
+    render_prometheus,
+)
+from scaletorch_tpu.telemetry.histogram import (
+    DEFAULT_SCHEMA,
+    BucketSchema,
+    LogHistogram,
+    TenantHistograms,
 )
 from scaletorch_tpu.telemetry.profiling import (
     AnomalyProfiler,
@@ -48,6 +56,12 @@ __all__ = [
     "TelemetryExporter",
     "PrometheusEndpoint",
     "SCHEMA_VERSION",
+    "BucketSchema",
+    "DEFAULT_SCHEMA",
+    "LogHistogram",
+    "TenantHistograms",
+    "render_families",
+    "render_prometheus",
     "AnomalyProfiler",
     "SlowStepDetector",
     "LiveSnapshotter",

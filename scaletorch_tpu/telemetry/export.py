@@ -41,11 +41,17 @@ SCHEMA_VERSION = 1
 # The event kinds the framework itself emits on the JSONL stream — ONE
 # schema, no parallel pipelines: the trainer's per-step records
 # (trainer/metrics.py), the engine's EngineMetrics snapshots
-# (inference/engine.py) and the gateway's GatewayMetrics snapshots
+# (inference/engine.py), the gateway's GatewayMetrics snapshots
 # (serving/gateway.py: per-tenant queue depth, shed/429 counts, SSE
-# streams open, router prefix-hit rate). Free-form kinds are allowed;
-# these are the ones consumers can rely on.
-KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics")
+# streams open, router prefix-hit rate), the gateway's per-request
+# ``access`` records (one per terminal HTTP outcome: tenant, outcome,
+# status, trace_id, queue_wait/ttft/e2e, tokens, prefix_hit, replica)
+# and its ``latency_histograms`` records (TenantHistograms.to_record —
+# sparse per-tenant bucket state, mergeable offline by slo_check).
+# Free-form kinds are allowed; these are the ones consumers can rely
+# on. Adding a kind is additive — v stays 1.
+KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics",
+               "access", "latency_histograms")
 
 
 class TelemetryExporter:
@@ -105,21 +111,102 @@ def read_jsonl(path: str) -> list:
 
 _METRIC_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
 
+_METRIC_TYPES = ("gauge", "counter", "histogram")
+
+
+def sanitize_metric_name(name: str) -> str:
+    return _METRIC_NAME_RE.sub("_", str(name))
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus exposition label-value escaping. Label values carry
+    UNTRUSTED client strings (tenant names reach /metrics verbatim), so
+    backslash, double-quote and newline must be escaped or a hostile
+    tenant name corrupts — or fabricates — exposition lines."""
+    return (str(value).replace("\\", "\\\\")
+            .replace("\n", "\\n").replace('"', '\\"'))
+
+
+def format_labels(labels: Optional[Dict[str, Any]]) -> str:
+    """``{k: v}`` -> ``{k="v",...}`` (sorted, escaped); "" when empty."""
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{sanitize_metric_name(k)}="{escape_label_value(v)}"'
+        for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _format_le(le: Optional[float]) -> str:
+    return "+Inf" if le is None else format(float(le), ".12g")
+
+
+def render_families(families, *, namespace: str = "scaletorch") -> str:
+    """Structured metric families -> Prometheus text exposition (0.0.4).
+
+    Each family is a dict: ``{"name", "type"}`` plus
+
+      * gauge/counter — ``"samples": [(labels_or_None, value)]``;
+      * histogram — ``"series": [(labels_or_None, hist)]`` where
+        ``hist`` quacks like ``telemetry.histogram.LogHistogram``
+        (``cumulative()`` yielding ``(le_or_None, cum_count)``, plus
+        ``sum``/``count``): rendered as real ``_bucket``/``_sum``/
+        ``_count`` series with an ``le`` label.
+
+    This is the renderer that fixes the PR 11 name-mangling: tenant and
+    replica identities ride LABELS (escaped — they are untrusted client
+    input), never the metric name."""
+    lines = []
+    for family in families:
+        name = f"{namespace}_{sanitize_metric_name(family['name'])}"
+        ftype = family.get("type", "gauge")
+        if ftype not in _METRIC_TYPES:
+            raise ValueError(
+                f"family {family['name']!r}: type must be one of "
+                f"{_METRIC_TYPES}, got {ftype!r}")
+        lines.append(f"# TYPE {name} {ftype}")
+        if ftype == "histogram":
+            series = list(family.get("series", ()))
+            # every series of one family must expose the SAME le set:
+            # consumers sum cumulative counts across label sets per le
+            # (Prometheus aggregation, slo_check's scrape parser), and
+            # a series whose tail buckets are elided would make that
+            # sum non-monotone — pad all to the family-wide maximum
+            min_buckets = max(
+                (h.occupied_finite_buckets() for _, h in series),
+                default=0)
+            for labels, hist in series:
+                base = dict(labels or {})
+                for le, cum in hist.cumulative(min_buckets=min_buckets):
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{format_labels({**base, 'le': _format_le(le)})}"
+                        f" {int(cum)}")
+                lines.append(
+                    f"{name}_sum{format_labels(base)} {float(hist.sum)}")
+                lines.append(
+                    f"{name}_count{format_labels(base)} {int(hist.count)}")
+            continue
+        for labels, value in family.get("samples", ()):
+            if isinstance(value, bool) or not isinstance(
+                    value, (int, float)):
+                continue
+            lines.append(f"{name}{format_labels(labels)} {float(value)}")
+    return "\n".join(lines) + "\n"
+
 
 def render_prometheus(metrics: Dict[str, float],
                       *, namespace: str = "scaletorch") -> str:
     """Flat numeric dict -> Prometheus text exposition format (0.0.4).
     Non-numeric values are skipped; names are sanitised to the metric
-    charset and prefixed with ``namespace_``."""
-    lines = []
-    for key in sorted(metrics):
-        value = metrics[key]
-        if isinstance(value, bool) or not isinstance(value, (int, float)):
-            continue
-        name = f"{namespace}_{_METRIC_NAME_RE.sub('_', str(key))}"
-        lines.append(f"# TYPE {name} gauge")
-        lines.append(f"{name} {float(value)}")
-    return "\n".join(lines) + "\n"
+    charset and prefixed with ``namespace_``. (The unlabeled-gauge
+    convenience wrapper over ``render_families``.)"""
+    return render_families(
+        ({"name": key, "type": "gauge", "samples": [(None, metrics[key])]}
+         for key in sorted(metrics)
+         if not isinstance(metrics[key], bool)
+         and isinstance(metrics[key], (int, float))),
+        namespace=namespace)
 
 
 class PrometheusEndpoint:

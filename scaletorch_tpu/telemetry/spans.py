@@ -189,6 +189,52 @@ class SpanTracer:
             "args": {"value": value},
         })
 
+    # ---- request-scoped async events -------------------------------------
+    # Chrome async events ("b"/"n"/"e") are keyed by (cat, id) rather
+    # than by thread: every event sharing an id renders on ONE async
+    # track no matter which thread emitted it. That is exactly the
+    # request-tracing shape — a serving request begins on the gateway's
+    # asyncio thread, crosses the EngineWorker bridge, and lives inside
+    # the engine tick loop, and its spans must correlate across all
+    # three. The id is the request's W3C trace_id
+    # (serving/protocol.parse_traceparent), so one Perfetto load shows
+    # the whole request next to the per-thread phase spans; the tid
+    # still records which thread emitted each event.
+
+    def async_event(self, ph: str, name: str, trace_id: str,
+                    **args: Any) -> None:
+        """One async event: ``ph`` is ``"b"`` (begin), ``"e"`` (end —
+        matched to its begin by (cat, id, name)) or ``"n"``
+        (instant)."""
+        if not self.enabled:
+            return
+        if ph not in ("b", "e", "n"):
+            raise ValueError(f"async ph must be 'b'/'e'/'n', got {ph!r}")
+        self._emit(self._async_event(ph, name, trace_id, args))
+
+    def async_begin(self, name: str, trace_id: str, **args: Any) -> None:
+        """Open one async span (``ph: "b"``) on the ``trace_id`` track."""
+        self.async_event("b", name, trace_id, **args)
+
+    def async_end(self, name: str, trace_id: str, **args: Any) -> None:
+        """Close the matching ``async_begin``."""
+        self.async_event("e", name, trace_id, **args)
+
+    def async_instant(self, name: str, trace_id: str, **args: Any) -> None:
+        """Point event on the ``trace_id`` track (``ph: "n"``)."""
+        self.async_event("n", name, trace_id, **args)
+
+    def _async_event(self, ph: str, name: str, trace_id: str,
+                     args: Dict[str, Any]) -> dict:
+        ev = {
+            "name": name, "ph": ph, "cat": "request", "id": str(trace_id),
+            "ts": self._now_us(),
+            "pid": self.process_index, "tid": threading.get_ident() & 0xFFFF,
+        }
+        if args:
+            ev["args"] = args
+        return ev
+
     def tail(self, last_n: Optional[int] = None) -> List[dict]:
         """The newest retained events (crash-report / live-snapshot
         surface); independent of the trace file."""

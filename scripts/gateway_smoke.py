@@ -4,16 +4,30 @@
 The CI ``gateway-smoke`` step (tier1.yml) runs this end to end on a CPU
 mesh:
 
-  1. boot ``scripts/serve.py --preset tiny`` as a real subprocess and
-     wait for its ``READY port=<p>`` line;
-  2. stream one greedy request over HTTP via urllib (SSE);
+  1. boot ``scripts/serve.py --preset tiny`` as a real subprocess
+     (with ``--telemetry_dir`` + ``--slo_path``) and wait for its
+     ``READY port=<p>`` line;
+  2. stream one greedy request over HTTP via urllib (SSE), carrying a
+     W3C ``traceparent`` header with a KNOWN trace id;
   3. rebuild the SAME deterministic tiny engine in-process (same
      ``--param_seed``) and assert the streamed tokens equal the direct
      ``InferenceEngine`` run BIT-FOR-BIT (the acceptance oracle: the
      gateway adds transport, never arithmetic);
-  4. scrape ``/healthz`` and ``/metrics``;
+  4. scrape ``/healthz`` (live SLO verdict) and ``/metrics``
+     (tenant-labeled histogram series; the scrape is saved for the CI
+     artifact + slo_check);
   5. SIGTERM the server and assert it drains to exit code 0 (the
-     exit-code contract's clean drain).
+     exit-code contract's clean drain);
+  6. post-mortem the telemetry artifacts: the Chrome trace must hold
+     the request's spans on BOTH the gateway thread and the engine
+     worker thread correlated by the trace id we sent (plus the tick
+     loop's phase spans), the access JSONL must carry the request's
+     record, and ``tools/slo_check.py`` must accept the JSONL AND the
+     /metrics scrape against the ``tiny`` SLO preset.
+
+Artifacts land in ``$GATEWAY_SMOKE_TELEMETRY`` (default
+``/tmp/gateway-smoke``) — CI uploads them and runs the slo_check gate
+on them again as a separate blocking step.
 
 Exit 0 = all green; any assertion prints a diagnostic and exits 1.
 """
@@ -23,6 +37,7 @@ from __future__ import annotations
 import json
 import os
 import queue
+import shutil
 import signal
 import subprocess
 import sys
@@ -38,11 +53,18 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 PROMPT = [1, 2, 3, 5, 8]
 MAX_NEW = 12
 SEED = 7
+TELEMETRY_DIR = os.environ.get("GATEWAY_SMOKE_TELEMETRY",
+                               "/tmp/gateway-smoke")
+TRACE_ID = "0af7651916cd43dd8448eb211c80319c"
+PARENT_SPAN = "b7ad6b7169203331"
 SERVE_ARGS = [
     "--preset", "tiny", "--param_seed", str(SEED),
     "--max_slots", "2", "--max_seq", "64", "--prefill_len", "16",
     "--cache_layout", "paged", "--page_size", "4",
     "--serve_port", "0",
+    "--telemetry_dir", TELEMETRY_DIR,
+    "--slo_path", os.path.join(REPO, "tools", "slo.json"),
+    "--slo_preset", "tiny",
 ]
 
 
@@ -91,7 +113,71 @@ def direct_engine_tokens() -> list:
     return engine.run()[rid].tokens
 
 
+def check_trace_correlation(trace_path: str) -> None:
+    """Acceptance: ONE Perfetto-loadable trace in which the request's
+    spans on the gateway (asyncio) thread and the engine worker thread
+    are correlated by the trace id we sent, next to the tick loop's
+    phase spans."""
+    from scaletorch_tpu.telemetry.spans import load_trace
+
+    events = load_trace(trace_path)
+    ours = [e for e in events if e.get("id") == TRACE_ID]
+    names = {e["name"] for e in ours}
+    gw_names = {"gw.request", "gw.queued", "gw.stream"}
+    engine_names = {"request", "req.queued", "req.prefill", "req.decode",
+                    "req.finalize"}
+    assert gw_names <= names, f"missing gateway spans: {gw_names - names}"
+    assert engine_names <= names, \
+        f"missing engine lifecycle spans: {engine_names - names}"
+    gw_tids = {e["tid"] for e in ours if e["name"] in gw_names}
+    engine_tids = {e["tid"] for e in ours if e["name"] in engine_names}
+    assert gw_tids and engine_tids and not (gw_tids & engine_tids), (
+        "request spans did not cross threads: gateway tids "
+        f"{gw_tids}, engine tids {engine_tids}")
+    tick_spans = {e["name"] for e in events
+                  if e.get("ph") == "X" and e.get("tid") in engine_tids}
+    assert {"tick", "decode", "prefill"} <= tick_spans, (
+        f"engine tick-loop phase spans missing on the worker thread: "
+        f"{tick_spans}")
+    outcome = [e for e in ours
+               if e["name"] == "req.finalize"][0]["args"]["outcome"]
+    assert outcome == "ok", outcome
+    print(f"[smoke] trace correlation OK: {len(ours)} request events "
+          f"across tids {sorted(gw_tids | engine_tids)}")
+
+
+def check_access_log(events_path: str) -> None:
+    access = [json.loads(line) for line in open(events_path)
+              if '"access"' in line]
+    access = [e for e in access if e.get("kind") == "access"]
+    assert len(access) == 1, f"want exactly one access record: {access}"
+    rec = access[0]
+    assert rec["v"] == 1 and rec["trace_id"] == TRACE_ID, rec
+    assert rec["tenant"] == "default" and rec["outcome"] == "ok", rec
+    assert rec["status"] == 200 and rec["replica"] == "r0", rec
+    assert rec["tokens"] == MAX_NEW, rec
+    assert rec["ttft_s"] > 0 and rec["e2e_s"] >= rec["ttft_s"], rec
+    assert rec["prefix_hit"] is False, rec
+    print("[smoke] access record OK")
+
+
+def run_slo_check(events_path: str, prom_path: str) -> None:
+    for extra in ([events_path], ["--prom", prom_path]):
+        cmd = [sys.executable, os.path.join(REPO, "tools", "slo_check.py"),
+               "--slo", os.path.join(REPO, "tools", "slo.json"),
+               "--preset", "tiny", *extra]
+        out = subprocess.run(cmd, capture_output=True, text=True, cwd=REPO)
+        sys.stdout.write(out.stdout)
+        assert out.returncode == 0, (
+            f"slo_check {extra} failed rc={out.returncode}:\n"
+            f"{out.stdout}{out.stderr}")
+    print("[smoke] slo_check OK (JSONL + /metrics scrape)")
+
+
 def main() -> int:
+    if os.path.isdir(TELEMETRY_DIR):
+        shutil.rmtree(TELEMETRY_DIR)  # stale artifacts must not pass
+    os.makedirs(TELEMETRY_DIR, exist_ok=True)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
@@ -106,10 +192,13 @@ def main() -> int:
 
         body = json.dumps({"prompt": PROMPT, "max_new_tokens": MAX_NEW,
                            "stream": True}).encode()
-        raw = urllib.request.urlopen(
-            urllib.request.Request(f"{base}/v1/generate", data=body,
-                                   method="POST"),
-            timeout=120).read()
+        request = urllib.request.Request(
+            f"{base}/v1/generate", data=body, method="POST")
+        request.add_header("traceparent",
+                           f"00-{TRACE_ID}-{PARENT_SPAN}-01")
+        response = urllib.request.urlopen(request, timeout=120)
+        echo = response.headers.get("traceparent", "")
+        raw = response.read()
         from scaletorch_tpu.serving.protocol import (
             parse_sse_stream,
             stream_tokens,
@@ -121,26 +210,51 @@ def main() -> int:
         assert len(dones) == 1, f"expected exactly one done event: {events}"
         assert dones[0]["outcome"] == "ok", dones[0]
         assert streamed == dones[0]["token_ids"], (streamed, dones[0])
+        # the trace id we sent round-tripped: response header + terminal
+        assert echo.startswith(f"00-{TRACE_ID}-"), echo
+        assert dones[0]["trace_id"] == TRACE_ID, dones[0]
 
         reference = direct_engine_tokens()
         assert streamed == reference, (
             f"SSE stream diverged from the direct engine:\n"
             f"  streamed:  {streamed}\n  reference: {reference}")
-        print(f"[smoke] SSE bit-parity OK over {len(streamed)} tokens")
+        print(f"[smoke] SSE bit-parity OK over {len(streamed)} tokens "
+              f"(traceparent round-tripped)")
 
         health = json.loads(
             urllib.request.urlopen(f"{base}/healthz", timeout=30).read())
         assert health["status"] == "ok", health
+        assert health["slo"]["ok"] is True, health["slo"]
+        assert health["slo"]["requests"] == 1, health["slo"]
         metrics = urllib.request.urlopen(
             f"{base}/metrics", timeout=30).read().decode()
         assert "scaletorch_http_requests_received 1.0" in metrics, \
             metrics[:400]
-        print("[smoke] /healthz + /metrics OK")
+        # tenant-labeled histogram series (labels sort le < tenant)
+        for needle in (
+            "# TYPE scaletorch_request_ttft_seconds histogram",
+            'scaletorch_request_ttft_seconds_count{tenant="default"} 1',
+            "scaletorch_request_tpot_seconds_bucket{le=",
+            'scaletorch_request_queue_wait_seconds_count'
+            '{tenant="default"} 1',
+            'scaletorch_engine_pages_in_use{replica="r0"}',
+        ):
+            assert needle in metrics, f"missing {needle}"
+        prom_path = os.path.join(TELEMETRY_DIR, "metrics_scrape.txt")
+        with open(prom_path, "w") as f:
+            f.write(metrics)
+        print("[smoke] /healthz (SLO ok) + /metrics histogram series OK")
 
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=120)  # the pump thread echoes the tail
         assert rc == 0, f"drain exit code {rc}, want 0"
         print("[smoke] SIGTERM drain exit 0 OK")
+
+        check_trace_correlation(
+            os.path.join(TELEMETRY_DIR, "serve.trace.json"))
+        events_path = os.path.join(TELEMETRY_DIR, "gateway_events.jsonl")
+        check_access_log(events_path)
+        run_slo_check(events_path, prom_path)
         return 0
     finally:
         if proc.poll() is None:

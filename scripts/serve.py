@@ -68,8 +68,17 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--serve_free_page_watermark", type=float, default=0.05)
     p.add_argument("--serve_default_ttl_s", type=float, default=0.0)
     p.add_argument("--telemetry_dir", default=None,
-                   help="Write gateway_metrics JSONL here "
-                        "(telemetry/export.py schema).")
+                   help="Observability root: gateway_metrics/access/"
+                        "latency_histograms JSONL (telemetry/export.py "
+                        "schema), one shared Chrome trace "
+                        "(serve.trace.json — gateway + every replica on "
+                        "one timeline, request spans correlated by W3C "
+                        "trace id), and SIGUSR1 live snapshots.")
+    p.add_argument("--slo_path", default="",
+                   help="tools/slo.json-grammar SLO file; /healthz then "
+                        "carries a live 'slo' verdict for --slo_preset.")
+    p.add_argument("--slo_preset", default="tiny",
+                   help="Preset name inside --slo_path (default tiny).")
     # gateway fault drills (ServingFaultInjector.from_config reads the
     # same field names; env SCALETORCH_TPU_FT_GW_* wins when present)
     p.add_argument("--ft_gw_tenant_storm_at", type=int, default=0)
@@ -104,7 +113,7 @@ def build_model(args):
     return cfg, llama.init_params(jax.random.PRNGKey(args.param_seed), cfg)
 
 
-def build_engine(args, cfg, params):
+def build_engine(args, cfg, params, tracer=None):
     from scaletorch_tpu.inference import InferenceEngine, SamplingParams
 
     return InferenceEngine(
@@ -114,6 +123,7 @@ def build_engine(args, cfg, params):
         sampling=SamplingParams(temperature=0.0),
         cache_layout=args.cache_layout, page_size=args.page_size,
         strict_submit=False,
+        tracer=tracer,
     )
 
 
@@ -123,17 +133,32 @@ def build_gateway(args):
     from scaletorch_tpu.serving.gateway import ServingGateway
 
     cfg, params = build_model(args)
-    engines = {
-        f"r{i}": build_engine(args, cfg, params)
-        for i in range(args.serve_replicas)
-    }
-    injector = ServingFaultInjector.from_config(args)
+    # ONE tracer shared by the gateway and every replica engine: the
+    # asyncio thread, the EngineWorker threads and the tick loops all
+    # write the same Chrome trace, so one Perfetto load shows a request
+    # crossing all of them, correlated by trace id
+    tracer = None
     exporter = None
     if args.telemetry_dir:
         from scaletorch_tpu.telemetry.export import TelemetryExporter
+        from scaletorch_tpu.telemetry.spans import SpanTracer
 
+        tracer = SpanTracer(
+            os.path.join(args.telemetry_dir, "serve.trace.json"),
+            role="serve")
         exporter = TelemetryExporter(
             os.path.join(args.telemetry_dir, "gateway_events.jsonl"))
+    slo_targets = None
+    if args.slo_path:
+        from scaletorch_tpu.serving.slo import load_slo, preset_targets
+
+        slo_targets = preset_targets(load_slo(args.slo_path),
+                                     args.slo_preset)
+    engines = {
+        f"r{i}": build_engine(args, cfg, params, tracer=tracer)
+        for i in range(args.serve_replicas)
+    }
+    injector = ServingFaultInjector.from_config(args)
     return ServingGateway(
         engines,
         host=args.serve_host, port=args.serve_port,
@@ -144,11 +169,46 @@ def build_gateway(args):
         default_ttl_s=args.serve_default_ttl_s,
         injector=injector if injector.active else None,
         exporter=exporter,
+        tracer=tracer,
+        slo_targets=slo_targets,
     )
+
+
+def make_snapshotter(args, gateway):
+    """SIGUSR1 live snapshots for a RUNNING gateway (the PR 8
+    LiveSnapshotter pointed at the serving process): span tail,
+    per-replica engine snapshots + histogram state, gateway gauges and
+    per-tenant latency histograms — without stopping anything."""
+    from scaletorch_tpu.telemetry.profiling import LiveSnapshotter
+
+    def snapshot_fn():
+        payload = {
+            "gateway": gateway.snapshot(),
+            "slo": gateway.slo_status(),
+            "tenant_histograms": gateway.hists.to_record(),
+            "replicas": {
+                rid: {
+                    "alive": worker.alive,
+                    "metrics": worker.gauges(),
+                    "histograms":
+                        worker.engine.metrics.histogram_state(),
+                }
+                for rid, worker in gateway.workers.items()
+            },
+        }
+        if gateway.tracer is not None:
+            payload["span_timeline_tail"] = gateway.tracer.tail(128)
+        return payload
+
+    return LiveSnapshotter(args.telemetry_dir, snapshot_fn)
 
 
 async def _main(args) -> int:
     gateway = build_gateway(args)
+    snapshotter = (make_snapshotter(args, gateway)
+                   if args.telemetry_dir else None)
+    if snapshotter is not None:
+        snapshotter.install()
     await gateway.start()
     print(f"READY port={gateway.port}", flush=True)
     stop = asyncio.Event()
@@ -160,6 +220,14 @@ async def _main(args) -> int:
     print("draining gateway...", flush=True)
     await gateway.stop(drain=True)
     serve.cancel()
+    if snapshotter is not None:
+        snapshotter.uninstall()
+    if gateway.tracer is not None:
+        # terminate the trace file AFTER the replicas drained (their
+        # worker threads emit into it until join) so it is valid JSON
+        gateway.tracer.close()
+    if gateway.exporter is not None:
+        gateway.exporter.close()
     return 0
 
 

@@ -322,3 +322,106 @@ class TestShardedServing:
                 params, jnp.asarray([toks], jnp.int32), cfg)
             toks.append(int(jnp.argmax(logits[0, -1])))
         assert results[rid].tokens == toks[3:]
+
+
+class TestRequestScopedObservability:
+    """ISSUE 12: trace_id threads through submit into lifecycle spans,
+    the terminal result carries latency attribution, and the engine's
+    latency histograms fill — all host-side, with greedy outputs and
+    the one-compile discipline untouched."""
+
+    TRACE = "0af7651916cd43dd8448eb211c80319c"
+
+    def test_result_latency_attribution_and_histograms(self, tiny_llama):
+        cfg, params = tiny_llama
+        eng = InferenceEngine(params, cfg, max_slots=2, max_seq=32,
+                              prefill_len=8,
+                              sampling=SamplingParams(temperature=0.0))
+        rid = eng.submit([1, 2, 3], max_new_tokens=6)
+        result = eng.run()[rid]
+        assert result.queue_wait_s is not None and result.queue_wait_s >= 0
+        assert result.prefill_s is not None and result.prefill_s > 0
+        assert result.prefix_hit is False
+        assert result.trace_id is None  # untraced submit stays untraced
+        hist = eng.metrics.hist
+        assert hist["ttft"].count == 1
+        assert hist["queue_wait"].count == 1
+        assert hist["prefill"].count == 1
+        assert hist["e2e"].count == 1
+        assert hist["tpot"].count == 5  # 6 tokens -> 5 inter-arrivals
+        state = eng.metrics.histogram_state()
+        assert state["e2e"]["count"] == 1
+        # distribution sanity: e2e covers ttft
+        assert hist["e2e"].quantile(0.5) >= hist["ttft"].min
+
+    def test_trace_id_spans_and_bit_identical_outputs(self, tiny_llama):
+        from scaletorch_tpu.telemetry.spans import SpanTracer
+
+        cfg, params = tiny_llama
+
+        def run(tracer, trace_id):
+            eng = InferenceEngine(params, cfg, max_slots=2, max_seq=32,
+                                  prefill_len=8, tracer=tracer,
+                                  sampling=SamplingParams(temperature=0.0))
+            rid = eng.submit([1, 2, 3], max_new_tokens=6,
+                             trace_id=trace_id)
+            result = eng.run()[rid]
+            assert eng.decode_compile_count == 1
+            return result
+
+        plain = run(None, None)
+        tracer = SpanTracer(path=None, role="serve")  # memory-only
+        traced = run(tracer, self.TRACE)
+        # instrumentation changes NOTHING functional
+        assert traced.tokens == plain.tokens
+        assert traced.trace_id == self.TRACE
+        ours = [e for e in tracer.tail() if e.get("id") == self.TRACE]
+        names = [e["name"] for e in ours]
+        for name in ("request", "req.queued", "req.admitted",
+                     "req.prefill", "req.decode", "req.finalize"):
+            assert name in names, (name, names)
+        # balanced async begin/end per span name
+        for name in ("request", "req.queued", "req.prefill", "req.decode"):
+            phases = [e["ph"] for e in ours if e["name"] == name]
+            assert phases == ["b", "e"], (name, phases)
+        finalize = [e for e in ours if e["name"] == "req.finalize"][0]
+        assert finalize["args"]["outcome"] == "ok"
+
+    def test_rejected_and_cancelled_spans_balance(self, tiny_llama):
+        from scaletorch_tpu.telemetry.spans import SpanTracer
+
+        cfg, params = tiny_llama
+        tracer = SpanTracer(path=None, role="serve")
+        eng = InferenceEngine(params, cfg, max_slots=1, max_seq=16,
+                              prefill_len=8, tracer=tracer,
+                              strict_submit=False,
+                              sampling=SamplingParams(temperature=0.0))
+        # rejected at submit: request + queued both close immediately
+        bad = eng.submit([], trace_id="11" * 16)
+        assert eng.result(bad).outcome == "rejected"
+        # cancelled while queued: queued span closes, never decode
+        rid = eng.submit([1, 2], max_new_tokens=4, trace_id="22" * 16)
+        assert eng.cancel(rid)
+        for trace_id in ("11" * 16, "22" * 16):
+            ours = [e for e in tracer.tail() if e.get("id") == trace_id]
+            for name in ("request", "req.queued"):
+                phases = [e["ph"] for e in ours if e["name"] == name]
+                assert phases == ["b", "e"], (trace_id, name, phases)
+            assert not any(e["name"] == "req.decode" for e in ours)
+
+    def test_unserved_outcomes_stay_out_of_e2e_histogram(self, tiny_llama):
+        """Instant rejects and client-cancelled (aborted) slots must
+        not feed the e2e tail estimate — only served (ok/timeout)
+        requests do."""
+        cfg, params = tiny_llama
+        eng = InferenceEngine(params, cfg, max_slots=1, max_seq=32,
+                              prefill_len=8, strict_submit=False,
+                              sampling=SamplingParams(temperature=0.0))
+        eng.submit([])  # rejected at submit
+        rid = eng.submit([1, 2], max_new_tokens=20)
+        eng.step()      # admitted, first token
+        assert eng.cancel(rid)  # aborted mid-decode, admit_time set
+        ok = eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.run()
+        assert eng.result(ok).outcome == "ok"
+        assert eng.metrics.hist["e2e"].count == 1  # the ok request only

@@ -171,8 +171,17 @@ class TestObservability:
                 "scaletorch_sse_streams_open",
                 "scaletorch_gateway_shed_total",
                 "scaletorch_router_prefix_route_rate",
-                "scaletorch_replica_r0_pages_in_use",
-                "scaletorch_replica_r0_queue_depth",
+                # replica identity rides a LABEL, not the metric name
+                'scaletorch_engine_pages_in_use{replica="r0"}',
+                'scaletorch_engine_queue_depth{replica="r0"}',
+                # tenant-labeled latency histograms: real histogram
+                # TYPE with _bucket/_sum/_count and an le label
+                "# TYPE scaletorch_request_ttft_seconds histogram",
+                'scaletorch_request_ttft_seconds_bucket{le=',
+                'scaletorch_request_ttft_seconds_count{tenant="default"} 1',
+                'scaletorch_request_e2e_seconds_sum{tenant="default"}',
+                'scaletorch_request_queue_wait_seconds_count'
+                '{tenant="default"} 1',
             ):
                 assert needle in text, f"missing {needle}"
         finally:
@@ -180,11 +189,27 @@ class TestObservability:
         exporter.close()
         events = read_jsonl(str(tmp_path / "gw.jsonl"))
         assert events, "no gateway_metrics records exported"
+        by_kind = {}
         for event in events:
             assert event["v"] == 1
-            assert event["kind"] == "gateway_metrics"
+            by_kind.setdefault(event["kind"], []).append(event)
+        for event in by_kind["gateway_metrics"]:
             assert "http_requests_received" in event
-        assert events[-1]["http_ok"] == 1
+        assert by_kind["gateway_metrics"][-1]["http_ok"] == 1
+        # one access record per terminal HTTP outcome
+        access = by_kind["access"]
+        assert len(access) == 1
+        rec = access[0]
+        assert rec["tenant"] == "default"
+        assert rec["outcome"] == "ok" and rec["status"] == 200
+        assert rec["replica"] == "r0"
+        assert rec["tokens"] == 2 and rec["prompt_tokens"] == 2
+        assert isinstance(rec["trace_id"], str) and len(rec["trace_id"]) == 32
+        assert rec["ttft_s"] > 0 and rec["e2e_s"] >= rec["ttft_s"]
+        assert rec["queue_wait_s"] >= 0
+        assert rec["prefix_hit"] is False
+        # the mergeable per-tenant histogram state rode the same stream
+        assert "latency_histograms" in by_kind
 
     def test_404_and_405(self, tiny_llama):
         gw = ServingGateway(make_engine(tiny_llama),
@@ -211,6 +236,219 @@ class TestObservability:
             except urllib.error.HTTPError as err:
                 status = err.code
             assert status == 405
+        finally:
+            gw.stop_sync()
+
+
+class TestKeepAlive:
+    """ROADMAP front-door item: scrape-heavy Prometheus consumers must
+    not pay a TCP connection per scrape — GET /metrics and /healthz
+    hold the connection open (HTTP/1.1 keep-alive) until the client
+    says Connection: close."""
+
+    @staticmethod
+    def _get_on(sock, path, close=False):
+        extra = "Connection: close\r\n" if close else ""
+        sock.sendall(
+            f"GET {path} HTTP/1.1\r\nHost: x\r\n{extra}\r\n".encode())
+        buf = b""
+        while b"\r\n\r\n" not in buf:
+            chunk = sock.recv(4096)
+            assert chunk, f"connection closed mid-response: {buf!r}"
+            buf += chunk
+        head, _, body = buf.partition(b"\r\n\r\n")
+        headers = head.decode().split("\r\n")
+        length = next(int(h.split(":", 1)[1]) for h in headers
+                      if h.lower().startswith("content-length"))
+        while len(body) < length:
+            chunk = sock.recv(4096)
+            assert chunk, "connection closed mid-body"
+            body += chunk
+        return headers, body[:length]
+
+    def test_scrape_connection_reuse(self, tiny_llama):
+        gw = ServingGateway(make_engine(tiny_llama),
+                            port=0).start_in_thread()
+        try:
+            sock = socket.create_connection(("127.0.0.1", gw.port),
+                                            timeout=30)
+            try:
+                # three requests over ONE connection, mixed endpoints
+                for path in ("/metrics", "/healthz", "/metrics"):
+                    headers, body = self._get_on(sock, path)
+                    assert headers[0].startswith("HTTP/1.1 200"), headers
+                    assert any("connection: keep-alive" in h.lower()
+                               for h in headers), headers
+                    assert body
+                # Connection: close is honored: response says close and
+                # the server actually closes
+                headers, _ = self._get_on(sock, "/healthz", close=True)
+                assert any("connection: close" in h.lower()
+                           for h in headers), headers
+                sock.settimeout(10)
+                assert sock.recv(4096) == b""
+            finally:
+                sock.close()
+        finally:
+            gw.stop_sync()
+
+
+class TestRequestTracing:
+    TRACE = "0af7651916cd43dd8448eb211c80319c"
+
+    def test_spans_correlated_across_threads_and_echoed(self, tiny_llama):
+        """One request's spans appear on BOTH the gateway (asyncio)
+        thread and the engine worker thread, correlated by the client's
+        trace id; the response echoes a traceparent and the terminal
+        payload carries the trace id."""
+        from scaletorch_tpu.telemetry.spans import SpanTracer
+
+        tracer = SpanTracer(path=None, role="serve")  # memory-only tail
+        engine = make_engine(tiny_llama, tracer=tracer)
+        gw = ServingGateway(engine, port=0,
+                            tracer=tracer).start_in_thread()
+        try:
+            status, headers, raw = post(
+                gw.port,
+                {"prompt": [1, 2, 3], "max_new_tokens": 4, "stream": True},
+                headers=[("traceparent",
+                          f"00-{self.TRACE}-b7ad6b7169203331-01")])
+            assert status == 200
+            assert headers.get("traceparent", "").startswith(
+                f"00-{self.TRACE}-")
+            dones = [d for e, d in parse_sse_stream(raw) if e == "done"]
+            assert dones[0]["trace_id"] == self.TRACE
+
+            # a MALFORMED traceparent degrades to a fresh trace — the
+            # request still succeeds and gets a well-formed id
+            status, headers2, raw2 = post(
+                gw.port,
+                {"prompt": [4, 5], "max_new_tokens": 2, "stream": False},
+                headers=[("traceparent", "garbage-in")])
+            assert status == 200
+            fresh = json.loads(raw2)["trace_id"]
+            assert len(fresh) == 32 and fresh != self.TRACE
+            assert headers2.get("traceparent", "").startswith(f"00-{fresh}")
+        finally:
+            gw.stop_sync()
+        ours = [e for e in tracer.tail() if e.get("id") == self.TRACE]
+        names = {e["name"] for e in ours}
+        assert {"gw.request", "gw.queued", "gw.stream"} <= names, names
+        assert {"request", "req.queued", "req.prefill", "req.decode",
+                "req.finalize"} <= names, names
+        gw_tids = {e["tid"] for e in ours if e["name"].startswith("gw.")}
+        eng_tids = {e["tid"] for e in ours if e["name"].startswith("req.")}
+        assert gw_tids and eng_tids and not (gw_tids & eng_tids), (
+            gw_tids, eng_tids)
+        finalize = [e for e in ours if e["name"] == "req.finalize"]
+        assert finalize[0]["args"]["outcome"] == "ok"
+
+    def test_untraced_gateway_works_without_tracer(self, tiny_llama):
+        """No tracer attached: the request still gets a trace id (for
+        the access log) and everything else behaves identically."""
+        gw = ServingGateway(make_engine(tiny_llama),
+                            port=0).start_in_thread()
+        try:
+            status, _, raw = post(
+                gw.port, {"prompt": [1], "max_new_tokens": 2,
+                          "stream": False})
+            assert status == 200
+            assert len(json.loads(raw)["trace_id"]) == 32
+        finally:
+            gw.stop_sync()
+
+
+class TestSLOHealthz:
+    def test_healthz_carries_live_slo_verdict(self, tiny_llama):
+        targets = {"min_requests": 1, "error_budget": 0.5,
+                   "targets": {"ttft_p95_s": 300.0, "e2e_p99_s": 300.0}}
+        gw = ServingGateway(make_engine(tiny_llama), port=0,
+                            slo_targets=targets).start_in_thread()
+        try:
+            status, raw = get(gw.port, "/healthz")
+            slo = json.loads(raw)["slo"]
+            assert slo["ok"] is True and slo.get("insufficient_data")
+            post(gw.port, {"prompt": [1, 2], "max_new_tokens": 2,
+                           "stream": False})
+            status, raw = get(gw.port, "/healthz")
+            assert status == 200
+            slo = json.loads(raw)["slo"]
+            assert slo["ok"] is True and slo["requests"] == 1
+            assert {c["name"] for c in slo["checks"]} == {
+                "error_budget", "ttft_p95_s", "e2e_p99_s"}
+        finally:
+            gw.stop_sync()
+
+    def test_refusals_do_not_feed_latency_histograms(self, tiny_llama):
+        """A 400/shed terminal takes microseconds — it must count as an
+        outcome but never as a latency observation, or overload would
+        drag the SLO quantiles DOWN (confirmed-bug regression)."""
+        gw = ServingGateway(make_engine(tiny_llama),
+                            port=0).start_in_thread()
+        try:
+            post(gw.port, {"prompt": []})  # 400 rejected
+            post(gw.port, {"prompt": [1, 2], "max_new_tokens": 2,
+                           "stream": False})
+        finally:
+            gw.stop_sync()
+        assert gw.metrics.outcomes["rejected"] == 1
+        assert gw.metrics.outcomes["ok"] == 1
+        assert gw.hists.merged("e2e").count == 1  # the served request only
+
+    def test_healthz_slo_violation_reported_not_fatal(self, tiny_llama):
+        """An SLO violation is a VERDICT on /healthz, not an outage:
+        the endpoint stays 200 (liveness and latency budgets are
+        different alarms)."""
+        targets = {"min_requests": 1, "error_budget": 1.0,
+                   "targets": {"ttft_p95_s": 1e-9}}
+        gw = ServingGateway(make_engine(tiny_llama), port=0,
+                            slo_targets=targets).start_in_thread()
+        try:
+            post(gw.port, {"prompt": [1, 2], "max_new_tokens": 2,
+                           "stream": False})
+            status, raw = get(gw.port, "/healthz")
+            assert status == 200
+            slo = json.loads(raw)["slo"]
+            assert slo["ok"] is False
+            assert slo["violations"] == ["ttft_p95_s"]
+        finally:
+            gw.stop_sync()
+
+
+class TestServeLiveSnapshotter:
+    def test_snapshot_fn_payload_shape(self, tiny_llama, tmp_path):
+        """scripts/serve.py's SIGUSR1 snapshot payload: span tail +
+        gateway gauges + per-tenant histograms + per-replica engine
+        snapshots/histograms (the handler itself is PR 8 machinery,
+        already signal-tested in tests/test_telemetry.py)."""
+        import os
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        sys.path.insert(0, os.path.join(repo, "scripts"))
+        import serve as serve_mod
+
+        from scaletorch_tpu.telemetry.spans import SpanTracer
+
+        tracer = SpanTracer(path=None, role="serve")
+        gw = ServingGateway(make_engine(tiny_llama, tracer=tracer),
+                            port=0, tracer=tracer).start_in_thread()
+        try:
+            post(gw.port, {"prompt": [1, 2], "max_new_tokens": 2,
+                           "stream": False})
+            args = serve_mod.parse_args(
+                ["--telemetry_dir", str(tmp_path)])
+            snapshotter = serve_mod.make_snapshotter(args, gw)
+            payload = snapshotter.snapshot_fn()
+            assert payload["gateway"]["http_requests_received"] == 1
+            assert payload["tenant_histograms"]["e2e"]["default"][
+                "count"] == 1
+            replica = payload["replicas"]["r0"]
+            assert replica["alive"] is True
+            assert replica["histograms"]["ttft"]["count"] == 1
+            assert payload["span_timeline_tail"]
+            assert payload["slo"] is None
         finally:
             gw.stop_sync()
 

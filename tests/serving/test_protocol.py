@@ -96,6 +96,80 @@ class TestOutcomeMapping:
         assert err["retry_after_s"] == 2.0
 
 
+class TestTraceparent:
+    """W3C trace-context parsing: valid headers round-trip, EVERYTHING
+    else degrades to None (fresh trace) — never an exception, never a
+    500 (the gateway handler relies on it)."""
+
+    TRACE = "0af7651916cd43dd8448eb211c80319c"
+    SPAN = "b7ad6b7169203331"
+
+    def test_valid_round_trip(self):
+        header = protocol.make_traceparent(self.TRACE, self.SPAN)
+        assert header == f"00-{self.TRACE}-{self.SPAN}-01"
+        assert protocol.parse_traceparent(header) == (self.TRACE, self.SPAN)
+        unsampled = protocol.make_traceparent(
+            self.TRACE, self.SPAN, sampled=False)
+        assert protocol.parse_traceparent(unsampled) == (self.TRACE,
+                                                         self.SPAN)
+
+    def test_surrounding_whitespace_ok(self):
+        header = f"  00-{self.TRACE}-{self.SPAN}-01  "
+        assert protocol.parse_traceparent(header) == (self.TRACE, self.SPAN)
+
+    def test_future_version_with_extra_fields_accepted(self):
+        header = f"cc-{self.TRACE}-{self.SPAN}-01-extra-stuff"
+        assert protocol.parse_traceparent(header) == (self.TRACE, self.SPAN)
+
+    @pytest.mark.parametrize("header", [
+        None,
+        "",
+        "00",
+        f"00-{TRACE}-{SPAN}",                      # missing flags
+        f"00-{TRACE}-{SPAN}-01-extra",             # v00 forbids extras
+        f"ff-{TRACE}-{SPAN}-01",                   # version ff invalid
+        f"00-{'0' * 32}-{SPAN}-01",                # all-zero trace id
+        f"00-{TRACE}-{'0' * 16}-01",               # all-zero span id
+        f"00-{TRACE.upper()}-{SPAN}-01",           # uppercase hex
+        f"00-{TRACE[:-1]}-{SPAN}-01",              # short trace id
+        f"00-{TRACE}-{SPAN}x-01",                  # long span id
+        f"00-{TRACE}-{SPAN}-0g",                   # non-hex flags
+        "00_" + TRACE,                             # wrong separators
+        "\x00\xff garbage \n",
+        "00-" + "zz" * 16 + f"-{SPAN}-01",
+    ])
+    def test_malformed_degrades_to_none(self, header):
+        assert protocol.parse_traceparent(header) is None
+
+    def test_malformed_fuzz_never_raises(self):
+        import random
+        import string
+
+        rng = random.Random(0)
+        alphabet = string.printable + "\x00\xff"
+        for _ in range(500):
+            header = "".join(rng.choice(alphabet)
+                             for _ in range(rng.randint(0, 80)))
+            result = protocol.parse_traceparent(header)
+            assert result is None or (
+                len(result[0]) == 32 and len(result[1]) == 16)
+
+    def test_fresh_ids_wellformed_and_distinct(self):
+        tid, sid = protocol.new_trace_id(), protocol.new_span_id()
+        assert len(tid) == 32 and int(tid, 16) != 0
+        assert len(sid) == 16 and int(sid, 16) != 0
+        assert protocol.new_trace_id() != tid
+        # a minted id parses back through its own header form
+        assert protocol.parse_traceparent(
+            protocol.make_traceparent(tid, sid)) == (tid, sid)
+
+    def test_result_payload_carries_trace_id(self):
+        done = protocol.result_payload(
+            1, outcome="ok", finish_reason="length", token_ids=[1],
+            prompt_tokens=1, trace_id=self.TRACE)
+        assert done["trace_id"] == self.TRACE
+
+
 class TestSSEFraming:
     def test_round_trip(self):
         raw = b"".join([

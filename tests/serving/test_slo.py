@@ -1,0 +1,318 @@
+"""SLO evaluation + the slo_check CI gate.
+
+Pure host-side: the evaluation logic (serving/slo.py) with fake
+quantiles, the checked-in tools/slo.json validating through the real
+loader, and tools/slo_check.py end-to-end over synthesized telemetry
+JSONL and a /metrics-shaped exposition.
+"""
+
+import json
+import math
+import os
+import sys
+
+import pytest
+
+from scaletorch_tpu.serving.slo import (
+    FAILURE_OUTCOMES,
+    evaluate_slo,
+    format_report,
+    load_slo,
+    parse_target_key,
+    preset_targets,
+    validate_preset,
+)
+from scaletorch_tpu.telemetry.histogram import LogHistogram
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+
+class TestTargetGrammar:
+    def test_parse_target_key(self):
+        assert parse_target_key("ttft_p95_s") == ("ttft", 0.95)
+        metric, q = parse_target_key("e2e_p99_9_s")
+        assert metric == "e2e" and q == pytest.approx(0.999)
+        assert parse_target_key("queue_wait_p50_s") == ("queue_wait", 0.5)
+
+    @pytest.mark.parametrize("key", [
+        "ttft", "ttft_p95", "p95_s", "ttft_p0_s", "ttft_p100_s",
+        "ttft_p95_ms", "TTFT_p95_s",
+    ])
+    def test_bad_keys_raise(self, key):
+        with pytest.raises(ValueError):
+            parse_target_key(key)
+
+    def test_validate_preset(self):
+        validate_preset("x", {"error_budget": 0.1, "min_requests": 5,
+                              "targets": {"ttft_p95_s": 1.0}})
+        with pytest.raises(ValueError, match="error_budget"):
+            validate_preset("x", {"error_budget": 2.0})
+        with pytest.raises(ValueError, match="positive"):
+            validate_preset("x", {"targets": {"ttft_p95_s": -1}})
+
+
+class TestEvaluate:
+    SPEC = {"min_requests": 2, "error_budget": 0.1,
+            "targets": {"ttft_p95_s": 1.0, "tpot_p99_s": 0.5}}
+
+    @staticmethod
+    def quantiles(values):
+        def fn(metric, q):
+            return values.get(metric)
+        return fn
+
+    def test_all_green(self):
+        result = evaluate_slo(
+            self.SPEC, quantile_fn=self.quantiles({"ttft": 0.5,
+                                                   "tpot": 0.1}),
+            outcomes={"ok": 10})
+        assert result["ok"] and not result["violations"]
+        assert result["burn_rate"] == 0.0
+
+    def test_latency_violation(self):
+        result = evaluate_slo(
+            self.SPEC, quantile_fn=self.quantiles({"ttft": 2.0}),
+            outcomes={"ok": 10})
+        assert not result["ok"]
+        assert result["violations"] == ["ttft_p95_s"]
+        # no tpot data -> skipped, never a violation
+        tpot = [c for c in result["checks"] if c["name"] == "tpot_p99_s"]
+        assert tpot[0].get("skipped")
+
+    def test_error_budget_burn(self):
+        # 2 timeouts in 10 = 20% > 10% budget -> burn 2.0
+        result = evaluate_slo(
+            self.SPEC, quantile_fn=self.quantiles({}),
+            outcomes={"ok": 8, "timeout": 2})
+        assert not result["ok"]
+        assert "error_budget" in result["violations"]
+        assert result["burn_rate"] == pytest.approx(2.0)
+
+    def test_policy_outcomes_spend_no_budget(self):
+        """shed/rejected/aborted are admission policy and client
+        behavior — a load-shedding gateway is healthy, not failing."""
+        assert set(FAILURE_OUTCOMES) == {"timeout", "quarantined"}
+        result = evaluate_slo(
+            self.SPEC, quantile_fn=self.quantiles({}),
+            outcomes={"ok": 2, "shed": 50, "rejected": 5, "aborted": 3})
+        assert result["ok"]
+
+    def test_zero_budget_zero_tolerance(self):
+        spec = dict(self.SPEC, error_budget=0.0)
+        result = evaluate_slo(
+            spec, quantile_fn=self.quantiles({}),
+            outcomes={"ok": 9, "quarantined": 1})
+        assert not result["ok"]
+        assert math.isinf(result["burn_rate"])
+
+    def test_insufficient_data_passes(self):
+        result = evaluate_slo(
+            self.SPEC, quantile_fn=self.quantiles({"ttft": 99.0}),
+            outcomes={"timeout": 1})
+        assert result["ok"] and result["insufficient_data"]
+        assert result["checks"] == []
+
+    def test_report_renders(self):
+        result = evaluate_slo(
+            self.SPEC, quantile_fn=self.quantiles({"ttft": 2.0}),
+            outcomes={"ok": 10})
+        text = format_report("tiny", result)
+        assert "VIOLATION" in text and "ttft_p95_s" in text
+
+
+class TestCheckedInFile:
+    def test_tools_slo_json_valid_with_expected_presets(self):
+        doc = load_slo(os.path.join(REPO, "tools", "slo.json"))
+        tiny = preset_targets(doc, "tiny")
+        assert tiny["error_budget"] == 0.0
+        assert "ttft_p95_s" in tiny["targets"]
+        preset_targets(doc, "production")
+        with pytest.raises(ValueError, match="unknown SLO preset"):
+            preset_targets(doc, "nope")
+
+
+def write_jsonl(path, events):
+    with open(path, "w") as f:
+        for event in events:
+            f.write(json.dumps(event) + "\n")
+
+
+def access(outcome="ok", **kw):
+    record = {"v": 1, "kind": "access", "time": 0.0, "proc": 0,
+              "tenant": "default", "outcome": outcome, "status": 200,
+              "trace_id": "ab" * 16, "queue_wait_s": 0.01,
+              "ttft_s": 0.2, "e2e_s": 0.5, "tokens": 4,
+              "prefix_hit": False, "replica": "r0"}
+    record.update(kw)
+    return record
+
+
+class TestSloCheckCLI:
+    def run_main(self, argv):
+        from tools.slo_check import main
+        return main(argv)
+
+    def test_green_from_access_records(self, tmp_path, capsys):
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [access() for _ in range(3)])
+        rc = self.run_main(["--slo", os.path.join(REPO, "tools", "slo.json"),
+                            "--preset", "tiny", path])
+        assert rc == 0
+        assert "OK" in capsys.readouterr().out
+
+    def test_violation_exits_1(self, tmp_path, capsys):
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [access(), access(outcome="timeout",
+                                            status=504)])
+        rc = self.run_main(["--slo", os.path.join(REPO, "tools", "slo.json"),
+                            "--preset", "tiny", path])
+        assert rc == 1
+        assert "error_budget" in capsys.readouterr().out
+
+    def test_histogram_records_cover_sample_free_metrics(self, tmp_path,
+                                                        capsys):
+        """tpot has no access-record scalar: the merged
+        latency_histograms records must answer its quantile — and a
+        slow TPOT must fail the gate."""
+        h = LogHistogram()
+        for _ in range(50):
+            h.observe(8.0)  # way over tiny's tpot_p99_s=5.0
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [
+            access(),
+            {"v": 1, "kind": "latency_histograms", "time": 0, "proc": 0,
+             "tpot": {"default": h.to_dict()}},
+        ])
+        rc = self.run_main(["--slo", os.path.join(REPO, "tools", "slo.json"),
+                            "--preset", "tiny", path])
+        out = capsys.readouterr().out
+        assert rc == 1 and "tpot_p99_s" in out
+
+    def test_cumulative_histogram_snapshots_counted_once(self, tmp_path,
+                                                         capsys):
+        """The gateway re-emits its WHOLE histogram state every export
+        cadence; slo_check must keep only the last snapshot per
+        process, not merge every record (which multi-counts early
+        observations — confirmed-bug regression)."""
+        early = LogHistogram()
+        for _ in range(32):
+            early.observe(0.5)
+        late = LogHistogram()
+        for _ in range(32):
+            late.observe(0.5)
+        for _ in range(968):
+            late.observe(0.01)  # steady state dominates the true p99
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [
+            access(),
+            {"v": 1, "kind": "latency_histograms", "time": 0, "proc": 0,
+             "tpot": {"default": early.to_dict()}},
+            {"v": 1, "kind": "latency_histograms", "time": 1, "proc": 0,
+             "tpot": {"default": late.to_dict()}},
+        ])
+        from tools.slo_check import collect, make_quantile_fn
+
+        samples, merged, outcomes, prom = collect([path], None)
+        assert merged["tpot"].count == 1000  # last snapshot, not 1032+
+        q = make_quantile_fn(samples, merged, prom)
+        assert q("tpot", 0.95) == pytest.approx(
+            late.quantile(0.95), rel=0.01)
+        capsys.readouterr()
+
+    def test_refusal_samples_excluded_from_latency_quantiles(
+            self, tmp_path):
+        """Shed/rejected access records terminate in microseconds;
+        their e2e samples must not dilute the served-latency quantiles
+        (they still count as outcomes)."""
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [
+            access(e2e_s=5.0),
+            *[access(outcome="shed", status=429, e2e_s=0.0001)
+              for _ in range(50)],
+        ])
+        from tools.slo_check import collect
+
+        samples, _, outcomes, _ = collect([path], None)
+        assert samples["e2e"] == [5.0]
+        assert outcomes["shed"] == 50  # outcomes keep counting
+
+    def test_aborted_ttft_sample_kept_e2e_dropped(self, tmp_path):
+        """An aborted stream's first token really arrived (ttft is
+        stamped at token arrival, like the gateway histograms), but its
+        truncated e2e must not feed the quantiles — keeps the access-
+        sample source consistent with the histogram/scrape sources."""
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [
+            access(),
+            access(outcome="aborted", status=503, ttft_s=0.9, e2e_s=1.0),
+        ])
+        from tools.slo_check import collect
+
+        samples, _, _, _ = collect([path], None)
+        assert sorted(samples["ttft"]) == [0.2, 0.9]
+        assert samples["e2e"] == [0.5]
+
+    def test_prom_label_values_containing_brace(self, tmp_path):
+        """'}' is legal inside a quoted Prometheus label value and
+        tenant names are untrusted — the scrape parser must not drop
+        such a tenant's series (confirmed-bug regression)."""
+        from scaletorch_tpu.telemetry.export import render_families
+        from tools.slo_check import parse_prom_text
+
+        h1, h2 = LogHistogram(), LogHistogram()
+        for _ in range(2):
+            h1.observe(0.1)
+            h2.observe(0.2)
+        text = render_families([
+            {"name": "request_ttft_seconds", "type": "histogram",
+             "series": [({"tenant": "a}b"}, h1), ({"tenant": "ok"}, h2)]},
+        ])
+        hists, _ = parse_prom_text(text)
+        pairs = sorted(hists["ttft"]._by_le.items())
+        assert pairs[-1][1] == 4  # +Inf cumulative covers BOTH tenants
+
+    def test_outcomes_fall_back_to_gateway_metrics(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        write_jsonl(path, [
+            {"v": 1, "kind": "gateway_metrics", "time": 0, "proc": 0,
+             "http_ok": 5, "http_timeout": 5},
+        ])
+        rc = self.run_main(["--slo", os.path.join(REPO, "tools", "slo.json"),
+                            "--preset", "tiny", path])
+        assert rc == 1  # 50% timeouts against a zero budget
+
+    def test_prom_scrape_source(self, tmp_path, capsys):
+        """The acceptance path: reconstruct quantiles from the
+        /metrics histogram exposition itself."""
+        from scaletorch_tpu.telemetry.export import render_families
+
+        h = LogHistogram()
+        for v in (0.1, 0.2, 0.4):
+            h.observe(v)
+        text = render_families([
+            {"name": "request_ttft_seconds", "type": "histogram",
+             "series": [({"tenant": "default"}, h)]},
+            {"name": "http_ok", "type": "counter", "samples": [(None, 3)]},
+        ])
+        prom = tmp_path / "metrics.txt"
+        prom.write_text(text)
+        rc = self.run_main(["--slo", os.path.join(REPO, "tools", "slo.json"),
+                            "--preset", "tiny", "--prom", str(prom)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "ttft_p95_s" in out and "OK" in out
+
+    def test_usage_errors_exit_2(self, tmp_path, capsys):
+        slo = os.path.join(REPO, "tools", "slo.json")
+        assert self.run_main(["--slo", slo, "--preset", "tiny"]) == 2
+        assert self.run_main(["--slo", slo, "--preset", "tiny",
+                              str(tmp_path / "missing.jsonl")]) == 2
+        assert self.run_main(["--slo", slo, "--preset", "nope",
+                              str(tmp_path / "missing.jsonl")]) == 2
+        bad = tmp_path / "bad.jsonl"
+        bad.write_text("{not json\n")
+        assert self.run_main(["--slo", slo, "--preset", "tiny",
+                              str(bad)]) == 2
+        capsys.readouterr()

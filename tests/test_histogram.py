@@ -1,0 +1,296 @@
+"""Property tests for the log-bucketed histogram primitive.
+
+The ISSUE-12 contract: bucket monotonicity, merge associativity, and
+quantile-estimate bounds against a sorted-sample oracle — plus the
+serialization round-trip, the registry's label-cardinality cap, and the
+Prometheus histogram exposition (telemetry/export.render_families).
+Pure stdlib under test; numpy only appears as a convenience RNG.
+"""
+
+import json
+import math
+import random
+
+import pytest
+
+from scaletorch_tpu.telemetry.export import (
+    escape_label_value,
+    format_labels,
+    render_families,
+    render_prometheus,
+)
+from scaletorch_tpu.telemetry.histogram import (
+    DEFAULT_SCHEMA,
+    OVERFLOW_LABEL,
+    BucketSchema,
+    LogHistogram,
+    TenantHistograms,
+)
+
+
+def lognormal_samples(rng, n, mu=-3.0, sigma=2.0):
+    """Latency-shaped positive samples spanning several decades."""
+    return [math.exp(rng.gauss(mu, sigma)) for _ in range(n)]
+
+
+class TestBucketSchema:
+    def test_bounds_strictly_monotone(self):
+        schema = DEFAULT_SCHEMA
+        assert all(a < b for a, b in zip(schema.bounds, schema.bounds[1:]))
+
+    def test_index_brackets_value(self):
+        """Every value lands in the bucket whose (lower, upper] range
+        contains it — including exact boundary values."""
+        schema = BucketSchema(lo=1e-3, growth=2.0, count=10)
+        rng = random.Random(0)
+        values = ([0.0, 1e-9, 1e-3, 2e-3, schema.bounds[-1],
+                   schema.bounds[-1] * 10]
+                  + [b for b in schema.bounds]
+                  + lognormal_samples(rng, 200))
+        for v in values:
+            i = schema.index(v)
+            if i == schema.count:
+                assert v > schema.bounds[-1]
+            else:
+                assert v <= schema.bounds[i]
+                if i > 0:
+                    assert v > schema.bounds[i - 1]
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            BucketSchema(lo=0.0)
+        with pytest.raises(ValueError):
+            BucketSchema(growth=1.0)
+        with pytest.raises(ValueError):
+            BucketSchema(count=0)
+
+
+class TestLogHistogram:
+    def test_counts_conserved_and_cumulative_monotone(self):
+        rng = random.Random(1)
+        h = LogHistogram()
+        values = lognormal_samples(rng, 500) + [0.0, 1e9]
+        for v in values:
+            h.observe(v)
+        assert h.count == len(values)
+        assert sum(h.counts) == len(values)
+        cum = h.cumulative()
+        assert cum[-1] == (None, len(values))
+        cs = [c for _, c in cum]
+        assert all(a <= b for a, b in zip(cs, cs[1:]))
+        les = [le for le, _ in cum[:-1]]
+        assert all(a < b for a, b in zip(les, les[1:]))
+
+    def test_negative_observations_clamp_to_zero(self):
+        h = LogHistogram()
+        h.observe(-1.0)
+        assert h.count == 1 and h.min == 0.0 and h.sum == 0.0
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_quantile_bounds_vs_sorted_oracle(self, seed):
+        """The estimate shares a bucket with the true order statistic:
+        relative error is bounded by the schema growth factor (and the
+        estimate always sits inside the observed [min, max])."""
+        rng = random.Random(seed)
+        # keep every sample above the lowest bound so the relative
+        # bound is exact (bucket 0 only guarantees absolute error <= lo)
+        values = [max(v, DEFAULT_SCHEMA.bounds[0] * 1.01)
+                  for v in lognormal_samples(rng, 400)]
+        h = LogHistogram()
+        for v in values:
+            h.observe(v)
+        ordered = sorted(values)
+        growth = DEFAULT_SCHEMA.growth
+        for q in (0.0, 0.01, 0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0):
+            est = h.quantile(q)
+            true = ordered[min(len(ordered) - 1,
+                               max(0, math.ceil(q * len(ordered)) - 1))]
+            assert h.min <= est <= h.max
+            assert est <= true * growth * (1 + 1e-9), (q, est, true)
+            assert est >= true / growth * (1 - 1e-9), (q, est, true)
+
+    def test_quantile_empty_and_bad_q(self):
+        h = LogHistogram()
+        assert h.quantile(0.5) is None
+        h.observe(1.0)
+        with pytest.raises(ValueError):
+            h.quantile(1.5)
+
+    @pytest.mark.parametrize("seed", [0, 3])
+    def test_merge_associative_and_equals_concatenation(self, seed):
+        rng = random.Random(seed)
+        parts = [lognormal_samples(rng, rng.randint(1, 80))
+                 for _ in range(3)]
+
+        def hist_of(values):
+            h = LogHistogram()
+            for v in values:
+                h.observe(v)
+            return h
+
+        a, b, c = (hist_of(p) for p in parts)
+        left = LogHistogram.combined(LogHistogram.combined(a, b), c)
+        right = LogHistogram.combined(a, LogHistogram.combined(b, c))
+        flat = hist_of([v for p in parts for v in p])
+        for other in (right, flat):
+            assert left.counts == other.counts
+            assert left.count == other.count
+            assert left.sum == pytest.approx(other.sum)
+            assert left.min == other.min and left.max == other.max
+        # the merged quantiles answer for the union
+        assert left.quantile(0.5) == flat.quantile(0.5)
+
+    def test_merge_schema_mismatch_raises(self):
+        with pytest.raises(ValueError, match="schema"):
+            LogHistogram(BucketSchema(lo=1e-4)).merge(
+                LogHistogram(BucketSchema(lo=1e-3)))
+
+    def test_dict_round_trip_is_sparse_and_exact(self):
+        rng = random.Random(7)
+        h = LogHistogram()
+        for v in lognormal_samples(rng, 100):
+            h.observe(v)
+        obj = json.loads(json.dumps(h.to_dict()))  # through real JSON
+        assert len(obj["buckets"]) < len(h.counts)  # sparse
+        back = LogHistogram.from_dict(obj)
+        assert back.counts == h.counts
+        assert back.count == h.count
+        assert back.quantile(0.9) == h.quantile(0.9)
+
+    def test_from_dict_rejects_corrupt_records(self):
+        h = LogHistogram()
+        h.observe(1.0)
+        bad = h.to_dict()
+        bad["count"] = 5  # buckets no longer sum to count
+        with pytest.raises(ValueError):
+            LogHistogram.from_dict(bad)
+        worse = h.to_dict()
+        worse["buckets"] = {"9999": 1}
+        with pytest.raises(ValueError):
+            LogHistogram.from_dict(worse)
+
+
+class TestTenantHistograms:
+    def test_observe_get_merged(self):
+        reg = TenantHistograms(("ttft", "e2e"))
+        reg.observe("ttft", "a", 0.1)
+        reg.observe("ttft", "a", 0.2)
+        reg.observe("ttft", "b", 0.4)
+        assert reg.get("ttft", "a").count == 2
+        assert reg.get("ttft", "missing") is None
+        merged = reg.merged("ttft")
+        assert merged.count == 3
+        assert reg.merged("e2e") is None
+        assert reg.total_count() == 3
+
+    def test_label_cardinality_cap_aggregates_not_drops(self):
+        reg = TenantHistograms(("ttft",), max_labels=4)
+        for i in range(10):
+            reg.observe("ttft", f"tenant{i}", 0.1)
+        series = reg.series("ttft")
+        assert len(series) <= 5  # 4 real labels + _other
+        assert OVERFLOW_LABEL in series
+        # every observation kept: attribution coarsened, data intact
+        assert reg.merged("ttft").count == 10
+
+    def test_record_round_trip_and_merge(self):
+        reg = TenantHistograms(("ttft",))
+        reg.observe("ttft", "a", 0.1)
+        reg.observe("ttft", "b", 0.2)
+        record = json.loads(json.dumps(reg.to_record()))
+        other = TenantHistograms(("ttft",))
+        other.merge_record(record)
+        other.merge_record(record)  # merging twice doubles counts
+        assert other.merged("ttft").count == 4
+
+
+class TestPrometheusRendering:
+    def test_label_escaping_of_hostile_values(self):
+        hostile = 'evil"} 1\nfake_metric{x="'
+        escaped = escape_label_value(hostile)
+        assert "\n" not in escaped
+        assert '\\"' in escaped
+        text = format_labels({"tenant": hostile})
+        assert text.count("\n") == 0
+
+    def test_families_gauge_counter_histogram(self):
+        h = LogHistogram(BucketSchema(lo=1.0, growth=2.0, count=4))
+        h.observe(1.5)
+        h.observe(100.0)  # overflow bucket
+        text = render_families([
+            {"name": "depth", "type": "gauge",
+             "samples": [({"tenant": "a"}, 3), ({"tenant": "b"}, 1)]},
+            {"name": "sheds", "type": "counter", "samples": [(None, 7)]},
+            {"name": "ttft_seconds", "type": "histogram",
+             "series": [({"tenant": "a"}, h)]},
+        ])
+        assert "# TYPE scaletorch_depth gauge" in text
+        assert 'scaletorch_depth{tenant="a"} 3.0' in text
+        assert "# TYPE scaletorch_sheds counter" in text
+        assert "scaletorch_sheds 7.0" in text
+        assert "# TYPE scaletorch_ttft_seconds histogram" in text
+        assert ('scaletorch_ttft_seconds_bucket{le="2",tenant="a"} 1'
+                in text)
+        assert ('scaletorch_ttft_seconds_bucket{le="+Inf",tenant="a"} 2'
+                in text)
+        assert 'scaletorch_ttft_seconds_count{tenant="a"} 2' in text
+        assert 'scaletorch_ttft_seconds_sum{tenant="a"} 101.5' in text
+
+    def test_family_series_share_one_le_set(self):
+        """Series of one family are padded to a common le set: a
+        consumer summing cumulative counts across label sets per le
+        (Prometheus aggregation, slo_check's scrape parser) must see a
+        monotone sequence — tail elision per-series would make a fast
+        tenant's observations vanish above its own max bucket."""
+        schema = BucketSchema(lo=1e-3, growth=2.0, count=20)
+        fast, slow = LogHistogram(schema), LogHistogram(schema)
+        for _ in range(100):
+            fast.observe(0.002)   # low bucket only
+        for _ in range(100):
+            slow.observe(10.0)    # high bucket
+        text = render_families([
+            {"name": "ttft_seconds", "type": "histogram",
+             "series": [({"tenant": "fast"}, fast),
+                        ({"tenant": "slow"}, slow)]},
+        ])
+        import re
+
+        summed = {}
+        for m in re.finditer(
+                r'ttft_seconds_bucket\{le="([^"]+)",tenant="\w+"\} (\d+)',
+                text):
+            summed[m.group(1)] = summed.get(m.group(1), 0) + int(m.group(2))
+        les = sorted(
+            (float("inf") if le == "+Inf" else float(le), c)
+            for le, c in summed.items())
+        counts = [c for _, c in les]
+        assert all(a <= b for a, b in zip(counts, counts[1:])), les
+        # both series expose every le, so the fast tenant's 100
+        # observations never drop out of the summed cumulative counts
+        # once past their bucket — without padding, every le above the
+        # fast tenant's top emitted bucket would dip back to slow-only
+        assert all(c >= 100 for le, c in les if le >= 0.002), les
+        assert counts[-1] == 200
+
+    def test_cumulative_min_buckets_padding(self):
+        h = LogHistogram(BucketSchema(lo=1.0, growth=2.0, count=8))
+        h.observe(1.0)  # bucket 0 only
+        assert len(h.cumulative()) == 2  # bucket 0 + +Inf
+        padded = h.cumulative(min_buckets=5)
+        assert len(padded) == 6
+        assert all(c == 1 for _, c in padded)
+        # min_buckets clamps at the schema size
+        assert len(h.cumulative(min_buckets=99)) == 9
+
+    def test_bad_family_type_raises(self):
+        with pytest.raises(ValueError, match="type"):
+            render_families([{"name": "x", "type": "summary",
+                              "samples": [(None, 1)]}])
+
+    def test_render_prometheus_back_compat(self):
+        body = render_prometheus(
+            {"tokens/s": 5.0, "occupancy": 0.5, "label": "skip-me"})
+        assert "# TYPE scaletorch_occupancy gauge" in body
+        assert "scaletorch_occupancy 0.5" in body
+        assert "scaletorch_tokens_s 5.0" in body
+        assert "skip-me" not in body
