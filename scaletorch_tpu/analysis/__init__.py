@@ -1,6 +1,6 @@
 """jaxlint — JAX-aware static analysis for scaletorch-tpu.
 
-Run as ``python -m scaletorch_tpu.analysis [paths]``. Six passes over
+Run as ``python -m scaletorch_tpu.analysis [paths]``. Eight passes over
 plain ASTs (nothing under analysis is imported):
 
 =====  ======================================================
@@ -10,11 +10,18 @@ ST3xx  PRNG hygiene (key reuse, wall-clock seeds)
 ST4xx  donation safety (read-after-donate)
 ST5xx  retrace risk (literal args to jitted callables)
 ST6xx  SPMD collective symmetry (host-divergent deadlocks)
+ST9xx  host-thread concurrency (races, deadlocks, loop abuse)
+       + the telemetry kind registry (ST907)
 =====  ======================================================
 
 ``--tier deep`` adds the compiled tier (needs jax): the jaxpr/HLO
 entry-point audit (ST7xx — ``jaxpr_audit.py``) and the per-entry comm
 budget gate (ST8xx — ``budget.py`` against ``tools/comm_budget.json``).
+``--tier concurrency`` runs only the ST9xx family (also part of the
+default ast tier).
+
+``--select`` accepts pass names or code families, case-insensitively:
+``--select ST9`` (or ``st901``) runs the concurrency family.
 
 Findings print as ``file:line: CODE severity message``; a checked-in
 baseline (``tools/jaxlint_baseline.json``) suppresses pre-existing
@@ -25,7 +32,16 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Set
 
-from . import donation, prng, retrace, sharding, symmetry, trace_safety
+from . import (
+    concurrency,
+    donation,
+    prng,
+    retrace,
+    sharding,
+    symmetry,
+    telemetry_kinds,
+    trace_safety,
+)
 from .core import (
     Finding,
     SourceModule,
@@ -43,13 +59,79 @@ PASSES = {
     "donation": donation.run,
     "retrace": retrace.run,
     "symmetry": symmetry.run,
+    "concurrency": concurrency.run,
+    "telemetry-kinds": telemetry_kinds.run,
 }
 
+# code family -> the AST passes that emit it (--select ST9, --tier
+# concurrency). ST7/ST8 are deep-tier and deliberately absent: selecting
+# them here is a usage error pointing at --tier deep.
+FAMILIES = {
+    "ST1": ("sharding",),
+    "ST2": ("trace-safety",),
+    "ST3": ("prng",),
+    "ST4": ("donation",),
+    "ST5": ("retrace",),
+    "ST6": ("symmetry",),
+    "ST9": ("concurrency", "telemetry-kinds"),
+}
+CONCURRENCY_PASSES = FAMILIES["ST9"]
+
 __all__ = [
-    "Finding", "SourceModule", "ProjectIndex", "PASSES",
+    "Finding", "SourceModule", "ProjectIndex", "PASSES", "FAMILIES",
+    "CONCURRENCY_PASSES",
     "collect_files", "load_baseline", "save_baseline", "split_by_baseline",
-    "analyze", "analyze_paths",
+    "analyze", "analyze_paths", "resolve_select",
 ]
+
+
+def resolve_select(select: Sequence[str]) -> List[str]:
+    """Selector tokens -> pass names. Tokens are matched
+    case-insensitively against pass names (``concurrency``) and code
+    families (``ST9``, or any code like ``ST904`` — the family prefix
+    wins). Unknown tokens raise ``ValueError`` naming every valid
+    choice, so a typo'd selector is a loud usage error (exit 2), never
+    a silently-green empty run."""
+    wanted: List[str] = []
+    valid_passes = {p.lower(): p for p in PASSES}
+    for token in select:
+        t = token.strip()
+        if not t:
+            continue
+        low = t.lower()
+        if low in valid_passes:
+            name = valid_passes[low]
+            if name not in wanted:
+                wanted.append(name)
+            continue
+        fam = None
+        # a family is exactly "STn" or a full code "STnxx" — trailing
+        # garbage ("ST9q") must NOT silently match a family
+        if low.startswith("st") and len(low) in (3, 5) and \
+                low[2:].isdigit():
+            fam = f"ST{low[2]}"
+        if fam in ("ST7", "ST8"):
+            raise ValueError(
+                f"selector {token!r} is a deep-tier family (ST7xx jaxpr/"
+                "HLO audit, ST8xx comm budget); run with --tier deep "
+                "instead of --select"
+            )
+        if fam in FAMILIES:
+            for name in FAMILIES[fam]:
+                if name not in wanted:
+                    wanted.append(name)
+            continue
+        raise ValueError(
+            f"unknown pass or family {token!r}; valid passes: "
+            f"{', '.join(sorted(PASSES))}; valid families: "
+            f"{', '.join(sorted(FAMILIES))}"
+        )
+    if not wanted:
+        raise ValueError(
+            f"empty selection; valid passes: {', '.join(sorted(PASSES))}; "
+            f"valid families: {', '.join(sorted(FAMILIES))}"
+        )
+    return wanted
 
 
 def analyze(
@@ -60,12 +142,7 @@ def analyze(
     """Run the selected passes (default: all) over parsed modules."""
     index = ProjectIndex(modules)
     findings: List[Finding] = []
-    wanted = set(select) if select else set(PASSES)
-    unknown = wanted - set(PASSES)
-    if unknown:
-        raise ValueError(
-            f"unknown pass(es) {sorted(unknown)}; available: {sorted(PASSES)}"
-        )
+    wanted = set(resolve_select(select)) if select else set(PASSES)
     for name, pass_fn in PASSES.items():
         if name not in wanted:
             continue

@@ -1,10 +1,14 @@
 """CLI: ``python -m scaletorch_tpu.analysis [paths] [options]``.
 
-Two tiers:
+Three tiers:
 
-* ``--tier ast`` (default) — the pure-AST passes (ST1xx-ST6xx). Never
-  imports the code under analysis and needs no jax: this is the fast,
-  dependency-free CI ``lint`` job.
+* ``--tier ast`` (default) — the pure-AST passes (ST1xx-ST6xx + the
+  ST9xx concurrency family). Never imports the code under analysis and
+  needs no jax: this is the fast, dependency-free CI ``lint`` job.
+* ``--tier concurrency`` — only the ST9xx family (thread-root/lockset
+  race & deadlock detection plus the telemetry kind registry); the
+  focused invocation is ``python -m scaletorch_tpu.analysis --select
+  ST9 <paths>`` and this tier is its spelled-out twin for CI.
 * ``--tier deep`` — additionally traces and compiles the registered
   entry-point manifest on virtual CPU meshes (jaxpr/HLO audit, ST7xx)
   and checks the per-entry comm budget (``tools/comm_budget.json``,
@@ -26,7 +30,16 @@ import os
 import sys
 from pathlib import Path
 
-from . import PASSES, analyze_paths, load_baseline, save_baseline, split_by_baseline
+from . import (
+    CONCURRENCY_PASSES,
+    FAMILIES,
+    PASSES,
+    analyze_paths,
+    load_baseline,
+    resolve_select,
+    save_baseline,
+    split_by_baseline,
+)
 
 DEFAULT_BASELINE = Path("tools") / "jaxlint_baseline.json"
 
@@ -64,9 +77,11 @@ def main(argv=None) -> int:
         help="files/directories to analyze (default: scaletorch_tpu)",
     )
     parser.add_argument(
-        "--tier", choices=("ast", "deep"), default="ast",
-        help="'ast' = pure-AST passes only (no jax); 'deep' also runs "
-             "the jaxpr/HLO entry-point audit and the comm-budget gate",
+        "--tier", choices=("ast", "concurrency", "deep"), default="ast",
+        help="'ast' = pure-AST passes only (no jax); 'concurrency' = "
+             "only the ST9xx thread-race/deadlock family; 'deep' also "
+             "runs the jaxpr/HLO entry-point audit and the comm-budget "
+             "gate",
     )
     parser.add_argument(
         "--baseline", type=Path, default=None,
@@ -82,7 +97,9 @@ def main(argv=None) -> int:
     )
     parser.add_argument(
         "--select", default=None, metavar="PASS[,PASS...]",
-        help=f"run only these passes (available: {', '.join(sorted(PASSES))})",
+        help="run only these passes or code families, case-insensitive "
+             f"(passes: {', '.join(sorted(PASSES))}; families: "
+             f"{', '.join(sorted(FAMILIES))} — e.g. --select ST9)",
     )
     parser.add_argument(
         "--extra-axes", default="", metavar="AXIS[,AXIS...]",
@@ -125,6 +142,24 @@ def main(argv=None) -> int:
 
     select = [s.strip() for s in args.select.split(",") if s.strip()] \
         if args.select else None
+    if args.tier == "concurrency":
+        # the tier IS a selection; an explicit --select narrows within it
+        try:
+            wanted = resolve_select(select) if select else \
+                list(CONCURRENCY_PASSES)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        narrowed = [p for p in wanted if p in CONCURRENCY_PASSES]
+        if not narrowed:
+            print(
+                f"error: --select {args.select!r} selects nothing inside "
+                f"--tier concurrency (its passes: "
+                f"{', '.join(CONCURRENCY_PASSES)})",
+                file=sys.stderr,
+            )
+            return 2
+        select = narrowed
     extra_axes = {s.strip() for s in args.extra_axes.split(",") if s.strip()}
     try:
         findings, errors = analyze_paths(
@@ -222,7 +257,7 @@ def main(argv=None) -> int:
         n_err = sum(1 for f in findings if f.severity == "error")
         n_warn = len(findings) - n_err
         tail = f" ({suppressed_count} baselined)" if suppressed_count else ""
-        tier = " [deep]" if args.tier == "deep" else ""
+        tier = f" [{args.tier}]" if args.tier != "ast" else ""
         print(
             f"jaxlint{tier}: {n_err} error(s), {n_warn} warning(s){tail}",
             file=sys.stderr,

@@ -4,10 +4,14 @@
 #
 #   bash scripts/run_lint.sh
 #
-# Two checks:
+# Three checks:
 #   1. jaxlint  — python -m scaletorch_tpu.analysis over the package and
 #      tools/, gated on tools/jaxlint_baseline.json (new findings fail).
-#   2. ruff     — pycodestyle/pyflakes/isort per [tool.ruff] in
+#      The default ast tier includes the ST9xx concurrency family.
+#   2. jaxlint --tier concurrency — the ST9xx thread-race/deadlock
+#      family spelled out on its own, so a red concurrency finding is
+#      unmissable in the log (focused local run: --select ST9).
+#   3. ruff     — pycodestyle/pyflakes/isort per [tool.ruff] in
 #      pyproject.toml. Skipped with a warning when ruff isn't installed
 #      (the TPU dev containers don't ship it; CI installs it).
 set -u -o pipefail
@@ -17,6 +21,14 @@ rc=0
 
 echo "== jaxlint (python -m scaletorch_tpu.analysis) =="
 JAX_PLATFORMS=cpu python -m scaletorch_tpu.analysis scaletorch_tpu/ tools/ || rc=1
+
+echo "== jaxlint concurrency tier (ST9xx races & deadlocks) =="
+# Under GitHub Actions the findings render as inline PR annotations;
+# locally they print as plain file:line diagnostics.
+fmt=text
+[ -n "${GITHUB_ACTIONS:-}" ] && fmt=github
+JAX_PLATFORMS=cpu python -m scaletorch_tpu.analysis --tier concurrency \
+    --format "$fmt" scaletorch_tpu/ tools/ || rc=1
 
 echo "== ruff check =="
 if command -v ruff >/dev/null 2>&1; then

@@ -1,0 +1,61 @@
+"""Seeded ST902/ST903 bugs: asyncio state poked off-loop, blocking
+calls on the loop (parsed, never imported)."""
+import asyncio
+import queue
+import threading
+import time
+
+
+class Bridge:
+    """Worker thread waking the loop by touching asyncio state raw."""
+
+    def __init__(self):
+        self._wake = asyncio.Event()
+        self._chan = asyncio.Queue()
+        self._loop = asyncio.get_event_loop()
+        self._inbox = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while True:
+            item = self._inbox.get()
+            # ST902: asyncio.Event.set from a worker thread — not
+            # thread-safe; must trampoline via call_soon_threadsafe
+            self._wake.set()
+            # ST902: raw put_nowait cross-thread, same hazard
+            self._chan.put_nowait(item)
+
+    def _run_trampolined(self, item):
+        # clean: the sanctioned cross-thread wake (never flags)
+        self._loop.call_soon_threadsafe(self._wake.set)
+        self._loop.call_soon_threadsafe(self._chan.put_nowait, item)
+
+    async def pump(self):
+        # ST903: blocking sleep on the event loop stalls every request
+        time.sleep(0.1)
+        # ST903: synchronous queue get blocks the loop
+        item = self._inbox.get()
+        await self._chan.put(item)
+
+    async def drain(self):
+        # clean: async primitives awaited on the loop never flag
+        await self._wake.wait()
+        while not self._chan.empty():
+            await asyncio.sleep(0)
+
+
+class Locky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = {}
+
+    async def update(self, key):
+        # ST903: a threading lock inside a coroutine blocks the whole
+        # event loop while contended (use asyncio.Lock)
+        with self._lock:
+            self.state[key] = 1
+
+    def update_sync(self, key):
+        # clean: the same lock in a sync method is the normal idiom
+        with self._lock:
+            self.state[key] = 2

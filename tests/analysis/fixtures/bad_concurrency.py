@@ -1,0 +1,80 @@
+"""Seeded ST901/ST904/ST905/ST906 bugs — each block is a shape the
+concurrency tier exists to catch (parsed, never imported)."""
+import signal
+import threading
+
+
+class Worker:
+    """Unlocked dict mutated by the worker thread AND its callers."""
+
+    def __init__(self):
+        self._counter = {}
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def submit(self, key):
+        # ST901: caller-side write, no lock — races _loop's pop below
+        self._counter[key] = 1
+
+    def _loop(self):
+        while True:
+            self._counter.pop("x", None)
+
+    def leak(self, key):
+        # ST905: bare acquire, no try/finally — an exception in
+        # between leaks the lock forever
+        self._lock.acquire()
+        del self._counter[key]
+        self._lock.release()
+
+
+class Tracer:
+    """Non-reentrant lock shared between main path and a handler."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []
+
+    def emit(self, ev):
+        with self._lock:
+            self.events.append(ev)
+
+    def tail(self):
+        # ST904: acquired here on the signal path (Snapshotter._handle)
+        with self._lock:
+            return list(self.events)
+
+
+class Snapshotter:
+    def __init__(self, tracer: Tracer):
+        self.tracer = tracer
+
+    def install(self):
+        signal.signal(signal.SIGUSR1, self._handle)
+
+    def _handle(self, signum, frame):
+        return self.tracer.tail()
+
+
+class Orderer:
+    """AB in one method, BA in another — the classic two-lock deadlock."""
+
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.state = {}
+
+    def ab(self):
+        with self._a:
+            # ST906: acquires _b while holding _a ...
+            with self._b:
+                self.state["k"] = 1
+
+    def ba(self):
+        with self._b:
+            # ... while this path acquires _a while holding _b
+            with self._a:
+                self.state["k"] = 2

@@ -1,0 +1,19 @@
+"""Seeded ST907: a JSONL kind emitted without registration in
+telemetry/export.py KNOWN_KINDS (parsed, never imported). The clean
+emits below use registered kinds and variables — neither flags."""
+
+
+class Reporter:
+    def __init__(self, exporter):
+        self.exporter = exporter
+
+    def flush(self, snap):
+        # clean: registered kind
+        self.exporter.emit("gateway_metrics", snap)
+        # ST907: schema drift — nothing registers this kind, so every
+        # consumer dispatching on `kind` silently drops the records
+        self.exporter.emit("replica_pool_metrics", snap)
+
+    def passthrough(self, kind, record):
+        # clean: variable kind is the facade contract, not drift
+        self.exporter.emit(kind, record)

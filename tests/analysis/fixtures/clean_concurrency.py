@@ -1,0 +1,155 @@
+"""Idiomatic concurrent host code that must lint CLEAN — each block is
+one of the real patterns the repo relies on (parsed, never imported):
+the worker-inbox trampoline with the dead-worker reap-lock discipline,
+`call_soon_threadsafe` cross-thread wakes, an RLock'd tracer shared
+with a signal handler, and the watchdog's plain-rebind beat writes."""
+import asyncio
+import queue
+import signal
+import threading
+import time
+
+
+class Worker:
+    """The gateway's EngineWorker shape: closures enqueued from the
+    event loop execute on the worker thread (no cross-root mutation),
+    and the exit-time reap runs under a dedicated lock."""
+
+    def __init__(self):
+        self._inbox = queue.SimpleQueue()
+        self._handlers = {}
+        self._reap_lock = threading.Lock()
+        self.alive = False
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self.alive = True
+        self._thread.start()
+
+    def submit(self, rid, handler):
+        def _do():
+            self._handlers[rid] = handler
+
+        self._inbox.put(_do)
+        if not self.alive:
+            self._reap_stale()
+
+    def _drain_inbox(self):
+        while True:
+            try:
+                fn = self._inbox.get_nowait()
+            except queue.Empty:
+                return
+            fn()
+
+    def _loop(self):
+        while self.alive:
+            self._drain_inbox()
+            for rid in list(self._handlers):
+                self._handlers.pop(rid, None)
+        self._reap_stale()
+
+    def _reap_stale(self):
+        # the reap-lock discipline: both reapers (worker exit, caller
+        # racing a dead inbox) serialize here — never flags
+        with self._reap_lock:
+            self._drain_inbox()
+
+
+class Gateway:
+    """The sanctioned cross-thread wake: worker-thread callbacks only
+    touch loop state through call_soon_threadsafe."""
+
+    def __init__(self):
+        self._wake = asyncio.Event()
+        self._loop = asyncio.get_event_loop()
+
+    def on_tick(self):
+        # runs on the worker thread; the trampoline is the fix ST902
+        # demands, so it must not flag
+        self._loop.call_soon_threadsafe(self._wake.set)
+
+    def attach(self, worker: Worker):
+        worker.tick_listeners = self.on_tick
+
+    async def dispatch(self):
+        await self._wake.wait()
+        self._wake.clear()
+        await asyncio.sleep(0)
+
+
+class Tracer:
+    """RLock'd tracer: safe to enter from a signal handler that
+    interrupted a holder on the same thread (the PR 8 fix)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.events = []
+
+    def emit(self, ev):
+        with self._lock:
+            self.events.append(ev)
+
+    def tail(self):
+        with self._lock:
+            return list(self.events)
+
+
+class Snapshotter:
+    def __init__(self, tracer: Tracer):
+        self.tracer = tracer
+
+    def install(self):
+        signal.signal(signal.SIGUSR1, self._handle)
+
+    def _handle(self, signum, frame):
+        return self.tracer.tail()
+
+
+class BareAcquire:
+    """acquire()/try-finally is the sanctioned bare-lock idiom: the
+    held set must include the acquired lock, or correctly serialized
+    cross-thread mutations would read as unlocked (ST901) — and the
+    paired finally release must satisfy ST905."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._state = {}
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def put(self, key):
+        self._lock.acquire()
+        try:
+            self._state[key] = 1
+        finally:
+            self._lock.release()
+
+    def _loop(self):
+        self._lock.acquire()
+        try:
+            self._state.pop("x", None)
+        finally:
+            self._lock.release()
+
+
+class Watchdog:
+    """Beat writes are plain rebinds of immutables — atomic under the
+    GIL, read by the watchdog thread; the idiom never flags."""
+
+    def __init__(self, timeout):
+        self.timeout = timeout
+        self._last_beat = time.monotonic()
+        self.last_phase = "start"
+        self.fired = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def beat(self, phase):
+        self.last_phase = phase
+        self._last_beat = time.monotonic()
+
+    def _run(self):
+        while not self._stop.wait(0.05):
+            if time.monotonic() - self._last_beat > self.timeout:
+                self.fired = True
+                return

@@ -77,6 +77,99 @@ class TestOutputFormat:
         assert rc == 0 and out == ""
 
 
+class TestSelectFamilies:
+    def test_family_prefix_selects_concurrency(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_concurrency.py"), "--no-baseline",
+            "--select", "ST9",
+        )
+        assert rc == 1
+        assert "ST901" in out and "ST904" in out
+
+    def test_family_is_case_insensitive(self, capsys):
+        rc_lower, out_lower, _ = run_cli(
+            capsys, str(FIXTURES / "bad_concurrency.py"), "--no-baseline",
+            "--select", "st9",
+        )
+        rc_code, out_code, _ = run_cli(
+            capsys, str(FIXTURES / "bad_concurrency.py"), "--no-baseline",
+            "--select", "ST901",
+        )
+        rc_name, out_name, _ = run_cli(
+            capsys, str(FIXTURES / "bad_concurrency.py"), "--no-baseline",
+            "--select", "Concurrency,Telemetry-Kinds",
+        )
+        assert rc_lower == rc_code == rc_name == 1
+        assert out_lower == out_code == out_name
+
+    def test_family_selects_other_passes_off(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_sharding.py"), "--no-baseline",
+            "--select", "ST9",
+        )
+        assert rc == 0 and out == ""
+
+    def test_unknown_family_exits_two_listing_valid(self, capsys):
+        """A typo'd selector must be a loud usage error naming every
+        valid family — never a silently-green empty selection."""
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--select", "ST0",
+        )
+        assert rc == 2
+        assert "ST9" in err and "ST1" in err  # the valid-family list
+
+    def test_family_with_trailing_garbage_rejected(self, capsys):
+        """'ST9q' must not silently match family ST9 and run green —
+        only exact 'STn' / full 'STnxx' tokens are families."""
+        for typo in ("ST9q", "st12", "ST9001"):
+            rc, _, err = run_cli(
+                capsys, str(FIXTURES / "clean.py"), "--select", typo,
+            )
+            assert rc == 2, typo
+            assert "unknown pass or family" in err, typo
+
+    def test_deep_family_points_at_deep_tier(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--select", "ST7",
+        )
+        assert rc == 2
+        assert "--tier deep" in err
+
+
+class TestConcurrencyTier:
+    def test_tier_runs_only_st9_family(self, capsys):
+        # bad_sharding.py is full of ST1xx, none of which run here
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_sharding.py"), "--no-baseline",
+            "--tier", "concurrency",
+        )
+        assert rc == 0 and out == ""
+
+    def test_tier_finds_concurrency_bugs(self, capsys):
+        rc, out, err = run_cli(
+            capsys, str(FIXTURES / "bad_concurrency.py"), "--no-baseline",
+            "--tier", "concurrency",
+        )
+        assert rc == 1
+        assert "ST901" in out
+        assert "[concurrency]" in err
+
+    def test_select_narrows_within_tier(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_kinds.py"), "--no-baseline",
+            "--tier", "concurrency", "--select", "telemetry-kinds",
+        )
+        assert rc == 1 and "ST907" in out
+
+    def test_foreign_select_inside_tier_is_usage_error(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"),
+            "--tier", "concurrency", "--select", "sharding",
+        )
+        assert rc == 2
+        assert "selects nothing" in err
+
+
 class TestGithubFormat:
     def test_error_and_warning_annotations(self, capsys):
         rc, out, _ = run_cli(

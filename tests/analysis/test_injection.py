@@ -117,6 +117,81 @@ class TestInjectedDivergentGather:
             assert findings == [], [f.render() for f in findings]
 
 
+class TestInjectedSignalHandlerLock:
+    """ST904 catches the PR 8 SpanTracer bug re-injected into the REAL
+    module: reverting the tracer's RLock to a plain Lock makes the
+    SIGUSR1 live-snapshot path (LiveSnapshotter._handle -> snapshot_fn
+    -> Telemetry.span_tail -> SpanTracer.tail) acquire a non-reentrant
+    lock the main emit path also holds — the deadlock human review
+    caught, now caught statically."""
+
+    COMPANIONS = ["telemetry/profiling.py", "telemetry/__init__.py",
+                  "trainer/trainer.py"]
+    SRC = PKG / "telemetry" / "spans.py"
+    NEEDLE = "self._lock = threading.RLock()"
+
+    def _concurrency(self, tmp_path, src):
+        mutated = tmp_path / "spans.py"
+        mutated.write_text(src, encoding="utf-8")
+        paths = [str(mutated)] + [str(PKG / c) for c in self.COMPANIONS]
+        modules, errors = collect_files(paths)
+        assert not errors
+        return analyze(modules, select=["concurrency"])
+
+    def test_rlock_reverted_to_lock_detected(self, tmp_path):
+        src = self.SRC.read_text()
+        assert self.NEEDLE in src, "SpanTracer lock moved; update test"
+        findings = self._concurrency(
+            tmp_path, src.replace(self.NEEDLE,
+                                  "self._lock = threading.Lock()")
+        )
+        st904 = [f for f in findings if f.code == "ST904"]
+        assert st904, [f.render() for f in findings]
+        assert any("_handle" in f.message and "SpanTracer._lock" in f.message
+                   for f in st904), [f.render() for f in st904]
+
+    def test_unmutated_telemetry_chain_is_clean(self, tmp_path):
+        findings = self._concurrency(tmp_path, self.SRC.read_text())
+        assert findings == [], [f.render() for f in findings]
+
+
+class TestInjectedUnlockedReap:
+    """ST901 catches the gateway's dead-worker reap race re-injected
+    into the REAL module: removing the `with self._reap_lock:` guard in
+    EngineWorker._reap_stale leaves `_handlers` mutated unlocked from
+    both the worker thread and the caller-side reap — the race human
+    review caught in PR 11."""
+
+    SRC = PKG / "serving" / "gateway.py"
+    NEEDLE = "        with self._reap_lock:"
+
+    def _concurrency(self, tmp_path, src):
+        mutated = tmp_path / "gateway.py"
+        mutated.write_text(src, encoding="utf-8")
+        modules, errors = collect_files([str(mutated)])
+        assert not errors
+        return analyze(modules, select=["concurrency"])
+
+    def test_reap_lock_removal_detected(self, tmp_path):
+        src = self.SRC.read_text()
+        assert self.NEEDLE in src, "_reap_stale lock moved; update test"
+        # `if True:` keeps the body's indentation valid while deleting
+        # the serialization — exactly the pre-review code shape
+        findings = self._concurrency(
+            tmp_path, src.replace(self.NEEDLE, "        if True:")
+        )
+        st901 = [f for f in findings if f.code == "ST901"]
+        assert any("_handlers" in f.message for f in st901), \
+            [f.render() for f in findings]
+
+    def test_unmutated_gateway_is_clean(self, tmp_path):
+        """The real trampoline + reap-lock discipline lints clean: the
+        pass proves the absence of the bug class in the module that
+        carries the serving path's concurrency."""
+        findings = self._concurrency(tmp_path, self.SRC.read_text())
+        assert findings == [], [f.render() for f in findings]
+
+
 class TestRepoGate:
     def test_package_and_tools_lint_clean_with_baseline(self):
         """The exact CI gate: repo findings minus baseline is empty."""
@@ -131,3 +206,21 @@ class TestRepoGate:
         entries = load_baseline(baseline_path) if baseline_path.is_file() else []
         new, _ = split_by_baseline(findings, entries)
         assert new == [], [f.render() for f in new]
+
+    def test_concurrency_tier_cli_gate(self, capsys):
+        """The exact CI invocation: `python -m scaletorch_tpu.analysis
+        --tier concurrency scaletorch_tpu/ tools/` exits 0 with zero
+        findings on the repo."""
+        import os
+
+        from scaletorch_tpu.analysis.__main__ import main
+
+        cwd = os.getcwd()
+        os.chdir(REPO)
+        try:
+            rc = main(["--tier", "concurrency", "scaletorch_tpu/",
+                       "tools/"])
+        finally:
+            os.chdir(cwd)
+        out = capsys.readouterr().out
+        assert rc == 0 and out == "", out

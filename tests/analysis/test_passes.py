@@ -118,6 +118,71 @@ class TestSymmetryPass:
         assert ("ST602", 42) in got  # save retried inside except handler
         assert ("ST603", 46) in got  # wall-clock-guarded barrier
 
+
+class TestConcurrencyPass:
+    def test_catches_seeded_bugs(self):
+        got = codes_at(run_fixture("bad_concurrency.py",
+                                   select=["concurrency"]))
+        assert ("ST901", 20) in got  # unlocked dict write, caller vs thread
+        assert ("ST905", 29) in got  # bare acquire, no try/finally
+        assert ("ST904", 47) in got  # Lock shared with a signal handler
+        assert ("ST906", 73) in got  # AB-BA lock-order cycle
+
+    def test_catches_seeded_async_bugs(self):
+        got = codes_at(run_fixture("bad_async.py", select=["concurrency"]))
+        assert ("ST902", 24) in got  # Event.set from a worker thread
+        assert ("ST902", 26) in got  # Queue.put_nowait cross-thread
+        assert ("ST903", 35) in got  # time.sleep on the loop
+        assert ("ST903", 37) in got  # sync queue.get on the loop
+        assert ("ST903", 55) in got  # threading lock held in a coroutine
+        # the SAME lock in a sync method (line 60) is the normal idiom
+        assert not any(line >= 58 for _, line in got)
+
+    def test_trampoline_idiom_not_flagged(self):
+        """The sanctioned call_soon_threadsafe wake in bad_async.py
+        (_run_trampolined, lines 28-31) must stay quiet — it is the fix
+        ST902's message prescribes."""
+        findings = run_fixture("bad_async.py", select=["concurrency"])
+        assert not [f for f in findings if 28 <= f.line <= 31], \
+            [f.render() for f in findings]
+
+    def test_clean_fixture_zero_findings_all_passes(self):
+        """The gateway-shaped clean fixture — worker-inbox trampoline
+        with the reap-lock discipline, call_soon_threadsafe wakes,
+        signal-handler RLock, watchdog plain-rebind beats — lints clean
+        under EVERY pass, not just ST9xx (zero-false-positive bar)."""
+        findings = run_fixture("clean_concurrency.py")
+        assert findings == [], [f.render() for f in findings]
+
+    def test_st904_names_both_paths(self):
+        findings = run_fixture("bad_concurrency.py", select=["concurrency"])
+        st904 = [f for f in findings if f.code == "ST904"]
+        assert len(st904) == 1
+        assert "_handle" in st904[0].message      # the signal side
+        assert "emit" in st904[0].message          # the main-path side
+        assert "RLock" in st904[0].message         # the prescribed fix
+
+
+class TestTelemetryKindsPass:
+    def test_unregistered_kind_flagged(self):
+        got = codes_at(run_fixture("bad_kinds.py",
+                                   select=["telemetry-kinds"]))
+        assert ("ST907", 15) in got
+        assert got == {("ST907", 15)}  # registered + variable kinds quiet
+
+    def test_registered_and_variable_kinds_not_flagged(self):
+        findings = run_fixture("bad_kinds.py", select=["telemetry-kinds"])
+        lines = {f.line for f in findings}
+        assert 12 not in lines  # "gateway_metrics" is registered
+        assert 19 not in lines  # variable kind: the facade pass-through
+
+    def test_registry_fallback_reads_package_source(self):
+        """bad_kinds.py is linted WITHOUT telemetry/export.py in the
+        analyzed set — the pass must fall back to the installed package
+        source for KNOWN_KINDS (the sharding pass's MESH_AXES idiom)."""
+        findings = run_fixture("bad_kinds.py", select=["telemetry-kinds"])
+        assert any("replica_pool_metrics" in f.message for f in findings)
+
     def test_severities(self):
         findings = run_fixture("bad_symmetry.py", select=["symmetry"])
         by_code = {f.code: f.severity for f in findings}
