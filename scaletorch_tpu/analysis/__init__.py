@@ -17,6 +17,9 @@ ST9xx  host-thread concurrency (races, deadlocks, loop abuse)
 ``--tier deep`` adds the compiled tier (needs jax): the jaxpr/HLO
 entry-point audit (ST7xx — ``jaxpr_audit.py``) and the per-entry comm
 budget gate (ST8xx — ``budget.py`` against ``tools/comm_budget.json``).
+``--tier memory`` compiles the same manifest and audits static HBM
+accounting (ST10xx — ``memory.py`` against ``tools/hbm_budget.json``);
+``--tier deep,memory`` runs both off one compile per entry.
 ``--tier concurrency`` runs only the ST9xx family (also part of the
 default ast tier).
 
@@ -104,6 +107,17 @@ def resolve_select(select: Sequence[str]) -> List[str]:
             if name not in wanted:
                 wanted.append(name)
             continue
+        # ST10 / ST10xx is the memory tier, not an AST pass — point at
+        # the tier before the single-digit family parse (which would
+        # otherwise read "st1001" as garbage, or nothing at all).
+        if low.startswith("st10") and (
+            len(low) == 4 or (len(low) == 6 and low[4:].isdigit())
+        ):
+            raise ValueError(
+                f"selector {token!r} is the memory-tier family (ST10xx "
+                "static HBM audit); run with --tier memory instead of "
+                "--select"
+            )
         fam = None
         # a family is exactly "STn" or a full code "STnxx" — trailing
         # garbage ("ST9q") must NOT silently match a family

@@ -1,6 +1,8 @@
 """CLI: ``python -m scaletorch_tpu.analysis [paths] [options]``.
 
-Three tiers:
+Four tiers — ``--tier`` takes one or a comma list (``--tier
+deep,memory`` keeps the CI deep-lint job a single invocation and a
+single compile of the entry-point manifest):
 
 * ``--tier ast`` (default) — the pure-AST passes (ST1xx-ST6xx + the
   ST9xx concurrency family). Never imports the code under analysis and
@@ -14,12 +16,18 @@ Three tiers:
   and checks the per-entry comm budget (``tools/comm_budget.json``,
   ST8xx). Needs jax; run under ``JAX_PLATFORMS=cpu`` (the CLI arranges
   8 virtual devices itself when jax is not yet initialized).
+* ``--tier memory`` — compiles the same manifest and checks static HBM
+  accounting (ST10xx, analysis/memory.py) against the per-entry peak
+  budget (``tools/hbm_budget.json``). When combined with ``deep``,
+  each entry compiles once and feeds both audits.
 
+An unknown tier is a loud exit-2 usage error, like an unknown pass.
 Exit codes: 0 clean (or all findings baselined), 1 findings or syntax
-errors, 2 usage error (unknown pass/entry, typo'd path, unreadable or
-malformed baseline/budget file). ``--write-baseline`` records current
-AST findings as the allowlist; ``--write-budget`` records the current
-compiled comm reports as the budget.
+errors, 2 usage error (unknown tier/pass/entry, typo'd path, unreadable
+or malformed baseline/budget file). ``--write-baseline`` records
+current AST findings as the allowlist; ``--write-budget`` /
+``--write-hbm-budget`` record the current compiled comm / memory
+reports as their budgets.
 """
 
 from __future__ import annotations
@@ -77,11 +85,14 @@ def main(argv=None) -> int:
         help="files/directories to analyze (default: scaletorch_tpu)",
     )
     parser.add_argument(
-        "--tier", choices=("ast", "concurrency", "deep"), default="ast",
-        help="'ast' = pure-AST passes only (no jax); 'concurrency' = "
-             "only the ST9xx thread-race/deadlock family; 'deep' also "
-             "runs the jaxpr/HLO entry-point audit and the comm-budget "
-             "gate",
+        "--tier", default="ast", metavar="TIER[,TIER...]",
+        help="comma list of: 'ast' = pure-AST passes only (no jax); "
+             "'concurrency' = only the ST9xx thread-race/deadlock "
+             "family; 'deep' also runs the jaxpr/HLO entry-point audit "
+             "and the comm-budget gate; 'memory' runs the static HBM "
+             "audit and the hbm-budget gate over the same compiled "
+             "manifest (e.g. --tier deep,memory compiles each entry "
+             "once for both)",
     )
     parser.add_argument(
         "--baseline", type=Path, default=None,
@@ -123,26 +134,67 @@ def main(argv=None) -> int:
         help="deep tier: skip the comm-budget comparison",
     )
     parser.add_argument(
+        "--hbm-budget", type=Path, default=None,
+        help="hbm budget file (default: tools/hbm_budget.json)",
+    )
+    parser.add_argument(
+        "--write-hbm-budget", action="store_true",
+        help="memory tier: write the current compiled memory reports as "
+             "the hbm budget and skip the comparison",
+    )
+    parser.add_argument(
+        "--no-hbm-budget", action="store_true",
+        help="memory tier: skip the hbm-budget comparison",
+    )
+    parser.add_argument(
         "--format", choices=("text", "json", "github"), default="text",
         help="'github' emits GitHub Actions ::error/::warning "
              "annotations so findings render inline on PRs",
     )
     args = parser.parse_args(argv)
 
-    if args.tier != "deep" and (
-        args.entries or args.write_budget or args.budget
-        or args.no_budget
+    known_tiers = ("ast", "concurrency", "deep", "memory")
+    tiers = [t.strip() for t in args.tier.split(",") if t.strip()]
+    unknown = sorted(set(tiers) - set(known_tiers))
+    if unknown or not tiers:
+        # A typo'd tier must be a loud usage error, never a silently
+        # green partial run — same contract as an unknown --select.
+        print(
+            f"error: unknown tier {', '.join(map(repr, unknown)) or '(empty)'}"
+            f"; valid tiers: {', '.join(known_tiers)} "
+            "(comma list, e.g. --tier deep,memory)",
+            file=sys.stderr,
+        )
+        return 2
+
+    if "deep" not in tiers and (
+        args.write_budget or args.budget or args.no_budget
     ):
         print(
-            "error: --entries/--write-budget/--budget/--no-budget need "
-            "--tier deep",
+            "error: --write-budget/--budget/--no-budget need --tier deep",
+            file=sys.stderr,
+        )
+        return 2
+    if "memory" not in tiers and (
+        args.hbm_budget or args.write_hbm_budget or args.no_hbm_budget
+    ):
+        print(
+            "error: --hbm-budget/--write-hbm-budget/--no-hbm-budget need "
+            "--tier memory",
+            file=sys.stderr,
+        )
+        return 2
+    need_compile = "deep" in tiers or "memory" in tiers
+    if args.entries and not need_compile:
+        print(
+            "error: --entries needs --tier deep or --tier memory",
             file=sys.stderr,
         )
         return 2
 
     select = [s.strip() for s in args.select.split(",") if s.strip()] \
         if args.select else None
-    if args.tier == "concurrency":
+    if "concurrency" in tiers and "ast" not in tiers:
         # the tier IS a selection; an explicit --select narrows within it
         try:
             wanted = resolve_select(select) if select else \
@@ -197,15 +249,30 @@ def main(argv=None) -> int:
         suppressed_count = len(suppressed)
 
     deep_findings = []
-    if args.tier == "deep":
+    if need_compile:
         _ensure_deep_env()
-        from . import budget as budget_mod
-        from .jaxpr_audit import audit_all
+        from .jaxpr_audit import audit_compiled, compile_entry, load_entries
 
         entry_names = [s.strip() for s in args.entries.split(",")
                        if s.strip()] if args.entries else None
-        audit_findings, reports = audit_all(entry_names)
-        deep_findings.extend(audit_findings)
+        # One compile per entry, shared by the deep and memory audits.
+        entries, load_findings = load_entries(entry_names)
+        deep_findings.extend(load_findings)
+        compiled_entries = []
+        for e in entries:
+            ce, fs = compile_entry(e)
+            deep_findings.extend(fs)
+            if ce is not None:
+                compiled_entries.append(ce)
+
+    if "deep" in tiers:
+        from . import budget as budget_mod
+
+        reports = {}
+        for ce in compiled_entries:
+            fs, report = audit_compiled(ce)
+            deep_findings.extend(fs)
+            reports[ce.entry["name"]] = report
         budget_path = args.budget or budget_mod.DEFAULT_BUDGET
         if args.write_budget:
             if entry_names and budget_path.is_file():
@@ -231,6 +298,40 @@ def main(argv=None) -> int:
                 print(f"error: {usage_error}", file=sys.stderr)
                 return 2
             deep_findings.extend(budget_findings)
+
+    if "memory" in tiers:
+        from . import memory as memory_mod
+
+        mem_reports = {}
+        mem_tops = {}
+        for ce in compiled_entries:
+            fs, report, top = memory_mod.audit_compiled_memory(ce)
+            deep_findings.extend(fs)
+            mem_reports[ce.entry["name"]] = report
+            mem_tops[ce.entry["name"]] = top
+        hbm_path = args.hbm_budget or memory_mod.DEFAULT_HBM_BUDGET
+        if args.write_hbm_budget:
+            if entry_names and hbm_path.is_file():
+                # scoped re-baseline merges, like --write-budget
+                try:
+                    existing = memory_mod.load_hbm_budget(hbm_path)
+                except ValueError as e:
+                    print(f"error: {e}", file=sys.stderr)
+                    return 2
+                mem_reports = {**existing["entries"], **mem_reports}
+            memory_mod.write_hbm_budget(hbm_path, mem_reports)
+            print(f"wrote hbm budget for {len(mem_reports)} entr"
+                  f"{'y' if len(mem_reports) == 1 else 'ies'} to "
+                  f"{hbm_path}",
+                  file=sys.stderr)
+        elif not args.no_hbm_budget:
+            hbm_findings, usage_error = memory_mod.check_hbm_budget_path(
+                mem_reports, hbm_path, tops=mem_tops
+            )
+            if usage_error is not None:
+                print(f"error: {usage_error}", file=sys.stderr)
+                return 2
+            deep_findings.extend(hbm_findings)
 
     # Gate semantics: AST findings and syntax errors fail regardless of
     # severity (the historical contract — retrace warnings etc. are

@@ -197,12 +197,27 @@ def load_entries(
     return entries, errors
 
 
-# -- the audit ----------------------------------------------------------------
+# -- the shared compile -------------------------------------------------------
 
-def audit_entry(entry: dict) -> Tuple[List[Finding], Optional[dict]]:
-    """(findings, comm report) for one built entry point. The report
-    feeds the comm-budget gate (analysis/budget.py) and is None when the
-    entry failed to compile."""
+@dataclasses.dataclass
+class CompiledEntry:
+    """One manifest entry traced and compiled exactly once — the shared
+    substrate of the deep tier (ST7xx/ST8xx, this module) and the memory
+    tier (ST10xx, analysis/memory.py), so ``--tier deep,memory`` pays a
+    single compile per entry."""
+
+    entry: dict
+    jaxpr: object          # ClosedJaxpr from the abstract trace
+    compiled: object       # jax Compiled (memory_analysis() lives here)
+    compiled_text: str     # compiled HLO text
+
+
+def compile_entry(
+    entry: dict,
+) -> Tuple[Optional["CompiledEntry"], List[Finding]]:
+    """Trace/lower/compile one built entry on the virtual mesh. Failures
+    become ST700 findings (the audit itself is part of the contract), in
+    which case the CompiledEntry is None."""
     import jax
 
     name = entry["name"]
@@ -222,29 +237,52 @@ def audit_entry(entry: dict) -> Tuple[List[Finding], Optional[dict]]:
                 "initialized)"
             ),
         ))
-        return findings, None
+        return None, findings
 
     try:
         traced = entry["fn"].trace(*entry["args"])
         jaxpr = traced.jaxpr
         lowered = (traced.lower() if hasattr(traced, "lower")
                    else entry["fn"].lower(*entry["args"]))
-        compiled_text = lowered.compile().as_text()
+        compiled = lowered.compile()
+        compiled_text = compiled.as_text()
     except Exception as exc:
         findings.append(Finding(
             file=file, line=1, code="ST700", severity="error",
             message=f"audit entry {name!r} failed to trace/compile: {exc!r}",
         ))
-        return findings, None
+        return None, findings
+    return CompiledEntry(
+        entry=entry, jaxpr=jaxpr, compiled=compiled,
+        compiled_text=compiled_text,
+    ), findings
 
-    cols = collect_jaxpr_collectives(jaxpr)
-    hlo_cols = parse_collectives(compiled_text)
 
+# -- the audit ----------------------------------------------------------------
+
+def audit_compiled(ce: "CompiledEntry") -> Tuple[List[Finding], dict]:
+    """The ST7xx checks + comm report over an already-compiled entry."""
+    entry = ce.entry
+    cols = collect_jaxpr_collectives(ce.jaxpr)
+    hlo_cols = parse_collectives(ce.compiled_text)
+
+    findings: List[Finding] = []
     findings.extend(_check_wire_dtype(entry, cols))
-    findings.extend(_check_donation(entry, compiled_text))
+    findings.extend(_check_donation(entry, ce.compiled_text))
     findings.extend(_check_hoisting(entry, cols))
     findings.extend(_check_replication(entry, hlo_cols))
     return findings, _comm_report(cols, hlo_cols)
+
+
+def audit_entry(entry: dict) -> Tuple[List[Finding], Optional[dict]]:
+    """(findings, comm report) for one built entry point. The report
+    feeds the comm-budget gate (analysis/budget.py) and is None when the
+    entry failed to compile."""
+    ce, findings = compile_entry(entry)
+    if ce is None:
+        return findings, None
+    fs, report = audit_compiled(ce)
+    return findings + fs, report
 
 
 def audit_all(

@@ -35,6 +35,7 @@ from scaletorch_tpu.inference.kv_cache import (  # noqa: F401
     PagedKVCache,
     PagedKVIO,
     RadixPrefixCache,
+    cache_nbytes,
     init_kv_cache,
     init_mla_cache,
     init_paged_kv_cache,

@@ -348,22 +348,28 @@ def teacher_forced_decode_paged(
     return jnp.concatenate(chunks, axis=1)
 
 
-def _audit_cfg_and_cache():
-    """Shared tiny setup for the two inference audit targets below."""
+def _audit_cfg_and_cache(compute_dtype: str = "fp32"):
+    """Shared tiny setup for the inference audit targets below.
+    ``compute_dtype`` selects the activation/cache dtype so the memory
+    tier's ST1003 injection tests can build a bf16-contracted entry;
+    the manifest default stays fp32 (the CPU-mesh numerics the parity
+    oracles attest)."""
     from scaletorch_tpu.inference.kv_cache import init_kv_cache
     from scaletorch_tpu.models.llama import LlamaConfig, init_params
 
+    dt = jnp.bfloat16 if compute_dtype in ("bf16", "bfloat16") \
+        else jnp.float32
     cfg = LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         head_dim=16, max_position_embeddings=256,
-        dtype=jnp.float32, param_dtype=jnp.float32,
+        dtype=dt, param_dtype=jnp.float32,
     )
     b, s_max = 2, 32
     params = jax.eval_shape(
         lambda: init_params(jax.random.PRNGKey(0), cfg))
     cache = jax.eval_shape(
-        lambda: init_kv_cache(cfg, b, s_max, dtype=jnp.float32))
+        lambda: init_kv_cache(cfg, b, s_max, dtype=dt))
     base_keys = jax.ShapeDtypeStruct((b, 2), jnp.uint32)
     return cfg, params, cache, base_keys, b, s_max
 
@@ -375,7 +381,16 @@ def audit_entry_prefill():
     which is exactly what the audit must not silently accept), and the
     single-device step compiles to ZERO collectives — any collective
     that appears is unbudgeted by definition (tools/comm_budget.json
-    records an empty set for this entry)."""
+    records an empty set for this entry).
+
+    Memory-tier contract (analysis/memory.py): the donated cache's
+    bytes show up as input/output alias savings (``donated_min_mb`` —
+    ST1002), and the engine's ``kv_cache_bytes`` for the dense layout
+    matches the compiled cache buffers (``kv_cache`` — ST1005). Pinned
+    here, NOT derived from the built objects, so a sizing drift fails
+    the gate instead of relaxing it."""
+    from scaletorch_tpu.inference.kv_cache import kv_cache_bytes
+
     cfg, params, cache, base_keys, b, s_max = _audit_cfg_and_cache()
     fn = make_prefill_step(
         cfg, SamplingParams(temperature=0.0), donate_cache=True)
@@ -387,6 +402,7 @@ def audit_entry_prefill():
         cache,
         base_keys,
     )
+    cache_mb = kv_cache_bytes(cfg, b, s_max, jnp.float32) / 1e6
     return {
         "name": "prefill_step",
         "file": "scaletorch_tpu/inference/decode.py",
@@ -397,15 +413,48 @@ def audit_entry_prefill():
         "expect_donation": True,
         "hoisted_axes": (),
         "max_collective_result_mb": 1.0,
+        "compute_dtype": "fp32",
+        "donated_min_mb": round(0.9 * cache_mb, 4),
+        "kv_cache": {
+            "cfg": cfg, "layout": "dense", "batch": b, "max_seq": s_max,
+            "dtype": jnp.float32, "arg_index": 4,
+        },
     }
 
 
-def audit_entry_decode():
+def audit_entry_decode(
+    compute_dtype: str = "fp32", fp32_residual: bool = False
+):
     """Deep-tier audit target: the jitted one-token decode step on one
-    device (same contract as ``audit_entry_prefill``)."""
-    cfg, params, cache, base_keys, b, _ = _audit_cfg_and_cache()
+    device (same contract as ``audit_entry_prefill``).
+
+    The kwargs exist so the memory-tier tests can inject exactly the
+    ST1003 regression: ``compute_dtype="bf16"`` builds the
+    bf16-contracted entry, ``fp32_residual=True`` routes the cache
+    through a large fp32 round-trip in the forward — the accidental
+    upcast the precision-leak check must attribute to its source line.
+    The manifest build stays fp32 (check inert, like the train steps).
+    """
+    from scaletorch_tpu.inference.kv_cache import kv_cache_bytes
+
+    cfg, params, cache, base_keys, b, s_max = \
+        _audit_cfg_and_cache(compute_dtype)
+    forward_fn = None
+    if fp32_residual:
+        base_fwd = resolve_forward_cached(cfg)
+
+        def forward_fn(p, tokens, c, kv, **kw):
+            logits, new_kv = base_fwd(p, tokens, c, kv, **kw)
+            # the injected leak: a full-cache fp32 round trip
+            new_kv = jax.tree.map(
+                lambda x: (x.astype(jnp.float32) + 0.0).astype(x.dtype),
+                new_kv,
+            )
+            return logits, new_kv
+
     fn = make_decode_step(
-        cfg, SamplingParams(temperature=0.0), donate_cache=True)
+        cfg, SamplingParams(temperature=0.0), forward_fn=forward_fn,
+        donate_cache=True)
     args = (
         params,
         jax.ShapeDtypeStruct((b,), jnp.int32),         # tokens
@@ -414,6 +463,8 @@ def audit_entry_decode():
         cache,
         base_keys,
     )
+    cache_dt = cache.k.dtype
+    cache_mb = kv_cache_bytes(cfg, b, s_max, cache_dt) / 1e6
     return {
         "name": "decode_step",
         "file": "scaletorch_tpu/inference/decode.py",
@@ -424,17 +475,36 @@ def audit_entry_decode():
         "expect_donation": True,
         "hoisted_axes": (),
         "max_collective_result_mb": 1.0,
+        "compute_dtype": compute_dtype,
+        # one cache buffer (k or v) counts as "large" — the smallest
+        # fp32 intermediate the leak injection materialises
+        "fp32_large_elems": 2048,
+        "donated_min_mb": round(0.9 * cache_mb, 4),
+        "kv_cache": {
+            "cfg": cfg, "layout": "dense", "batch": b, "max_seq": s_max,
+            "dtype": cache_dt, "arg_index": 4,
+        },
     }
 
 
-def audit_entry_paged_decode():
+def audit_entry_paged_decode(pool_pages: Optional[int] = None):
     """Deep-tier audit target: the jitted paged one-token decode step on
     one device. Contract: donation of the PAGE POOL survives lowering
     (the pool is the whole serving cache — losing the alias doubles
     serving HBM per step) and the single-device step compiles to ZERO
     collectives (empty budget row in tools/comm_budget.json, like the
-    dense steps)."""
-    from scaletorch_tpu.inference.kv_cache import init_paged_kv_cache
+    dense steps).
+
+    Memory-tier contract: the ``kv_cache`` sizing is pinned to the
+    DEFAULT pool (``b * max_pages + 1`` pages, the dense-equivalent +
+    trash page) regardless of ``pool_pages`` — the kwarg exists so the
+    ST1005 tests can build a shrunken pool and prove the gate catches
+    the engine/compiled-bytes drift, exactly the PR 6 injection style.
+    """
+    from scaletorch_tpu.inference.kv_cache import (
+        init_paged_kv_cache,
+        kv_cache_bytes,
+    )
 
     cfg, params, _, base_keys, b, s_max = _audit_cfg_and_cache()
     page_size = 8
@@ -442,7 +512,8 @@ def audit_entry_paged_decode():
     num_pages = b * max_pages + 1
     pool = jax.eval_shape(
         lambda: init_paged_kv_cache(
-            cfg, num_pages, page_size, dtype=jnp.float32))
+            cfg, pool_pages if pool_pages is not None else num_pages,
+            page_size, dtype=jnp.float32))
     fn = make_paged_decode_step(
         cfg, SamplingParams(temperature=0.0), page_size=page_size,
         seq_limit=s_max, donate_cache=True)
@@ -455,6 +526,9 @@ def audit_entry_paged_decode():
         pool,
         base_keys,
     )
+    pool_mb = kv_cache_bytes(
+        cfg, b, s_max, jnp.float32, layout="paged", page_size=page_size,
+        num_pages=num_pages) / 1e6
     return {
         "name": "paged_decode_step",
         "file": "scaletorch_tpu/inference/decode.py",
@@ -465,6 +539,13 @@ def audit_entry_paged_decode():
         "expect_donation": True,
         "hoisted_axes": (),
         "max_collective_result_mb": 1.0,
+        "compute_dtype": "fp32",
+        "donated_min_mb": round(0.9 * pool_mb, 4),
+        "kv_cache": {
+            "cfg": cfg, "layout": "paged", "batch": b, "max_seq": s_max,
+            "dtype": jnp.float32, "page_size": page_size,
+            "num_pages": num_pages, "arg_index": 5,
+        },
     }
 
 

@@ -117,6 +117,18 @@ def kv_cache_bytes(
     return 2 * n * dt.itemsize
 
 
+def cache_nbytes(cache: Any) -> int:
+    """Actual bytes of a cache pytree (arrays OR ShapeDtypeStructs) —
+    the measured twin of :func:`kv_cache_bytes`. The jaxlint memory
+    tier's ST1005 check (analysis/memory.py) and the quick-tier
+    cross-check tests compare the two so bench_decode's HBM column and
+    the engine's page-budget admission math can never drift from what
+    XLA actually allocates."""
+    from scaletorch_tpu.utils.misc import tree_bytes
+
+    return tree_bytes(cache)
+
+
 def init_kv_cache(
     cfg,
     batch: int,

@@ -873,6 +873,13 @@ def audit_entry(
         "expect_donation": True,
         "hoisted_axes": ("dp",),
         "max_collective_result_mb": max(1.0, 4.0 * param_mb),
+        # memory-tier contract (analysis/memory.py): donated params must
+        # actually alias outputs (ST1002 — bytes, not just presence like
+        # ST702). memory_analysis() accounts PER DEVICE and this mesh
+        # shards params over tp=2, so the floor is ~half the global
+        # param bytes (0.45 = 0.9 slack x the 1/2 tp shard).
+        "compute_dtype": "fp32",
+        "donated_min_mb": round(0.45 * param_mb, 4),
     }
 
 

@@ -361,6 +361,10 @@ def audit_entry(
         "expect_donation": True,
         "hoisted_axes": ("dp",),
         "max_collective_result_mb": max(1.0, 4.0 * param_mb),
+        # memory-tier contract (analysis/memory.py): see
+        # parallel/spmd.audit_entry for field semantics
+        "compute_dtype": "fp32",
+        "donated_min_mb": round(0.9 * param_mb, 4),
     }
 
 

@@ -135,6 +135,78 @@ class TestSelectFamilies:
         assert rc == 2
         assert "--tier deep" in err
 
+    def test_memory_family_points_at_memory_tier(self, capsys):
+        """ST10/ST1001 are memory-tier codes, not AST passes — like
+        ST7/ST8, selecting them must point at the tier, and ST10 must
+        NOT parse as the ST1 sharding family."""
+        for sel in ("ST10", "st1001"):
+            rc, _, err = run_cli(
+                capsys, str(FIXTURES / "clean.py"), "--select", sel,
+            )
+            assert rc == 2, sel
+            assert "--tier memory" in err, (sel, err)
+
+
+class TestTierList:
+    def test_unknown_tier_exits_two(self, capsys):
+        """A typo'd tier must be a loud usage error naming the valid
+        tiers — never a silently-green partial run."""
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--tier", "nonsense",
+        )
+        assert rc == 2
+        assert "unknown tier" in err and "memory" in err
+
+    def test_unknown_member_of_comma_list_exits_two(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--tier", "deep,nonsense",
+        )
+        assert rc == 2
+        assert "'nonsense'" in err
+
+    def test_empty_tier_exits_two(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--tier", ",",
+        )
+        assert rc == 2
+        assert "unknown tier" in err
+
+    def test_ast_concurrency_list_runs_all_ast_passes(self, capsys):
+        """'ast' in the list wins over the concurrency narrowing: the
+        ST1xx fixture must still be flagged."""
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_sharding.py"), "--no-baseline",
+            "--tier", "ast,concurrency",
+        )
+        assert rc == 1
+        assert "ST101" in out
+
+    def test_tier_tag_in_summary_names_the_list(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--no-baseline",
+            "--tier", "concurrency",
+        )
+        assert rc == 0
+        assert "[concurrency]" in err
+
+    def test_hbm_flags_need_memory_tier(self, capsys):
+        for flag in (["--write-hbm-budget"], ["--no-hbm-budget"],
+                     ["--hbm-budget", "x.json"]):
+            rc, _, err = run_cli(
+                capsys, str(FIXTURES / "clean.py"), *flag
+            )
+            assert rc == 2, flag
+            assert "--tier memory" in err
+
+    def test_comm_budget_flags_still_need_deep_tier(self, capsys):
+        """--tier memory alone must not unlock the comm-budget flags."""
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"),
+            "--tier", "memory", "--write-budget",
+        )
+        assert rc == 2
+        assert "--tier deep" in err
+
 
 class TestConcurrencyTier:
     def test_tier_runs_only_st9_family(self, capsys):

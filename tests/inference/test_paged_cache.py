@@ -367,3 +367,29 @@ class TestCacheBytesLayouts:
             kv_cache_bytes(cfg, 1, 8, layout="ragged")
         with pytest.raises(ValueError, match="page_size"):
             kv_cache_bytes(cfg, 1, 8, layout="paged")
+
+    def test_engine_pool_matches_admission_math(self):
+        """The ISSUE 15 unification: the bytes the engine's admission /
+        shedding math reasons about (``kv_cache_bytes``) and the bytes
+        the engine actually allocated (``cache_nbytes`` over the live
+        pool/cache) must agree exactly, for both layouts — the jaxlint
+        memory tier's ST1005 pins the same identity over the COMPILED
+        audit entries, so bench_decode's HBM column can never drift."""
+        from scaletorch_tpu.inference import InferenceEngine, SamplingParams
+        from scaletorch_tpu.inference.kv_cache import cache_nbytes
+
+        cfg = llama.LlamaConfig(**TINY)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        paged = InferenceEngine(
+            params, cfg, sampling=SamplingParams(temperature=0.0),
+            max_slots=2, max_seq=16, cache_layout="paged", page_size=4,
+        )
+        assert cache_nbytes(paged.cache) == kv_cache_bytes(
+            cfg, 2, 16, cfg.dtype, layout="paged", page_size=4,
+            num_pages=paged.num_pages)
+        dense = InferenceEngine(
+            params, cfg, sampling=SamplingParams(temperature=0.0),
+            max_slots=2, max_seq=16,
+        )
+        assert cache_nbytes(dense.cache) == kv_cache_bytes(
+            cfg, 2, 16, cfg.dtype)

@@ -17,6 +17,11 @@ Usage:
 
 Prints one JSON line per variant: argument/temp/output/alias bytes,
 estimated peak HBM, and fits_hbm for the generation's per-chip HBM.
+The accounting itself (argument/temp/alias/peak math) is shared with
+the jaxlint memory tier (``scaletorch_tpu/analysis/memory.py``), which
+gates the same numbers for the audit manifest in CI against
+``tools/hbm_budget.json``; this tool keeps the libtpu AOT topology
+path so the numbers come out for a real TPU generation.
 """
 
 from __future__ import annotations
@@ -159,6 +164,8 @@ def build_lowered(model: str, *, seq: int, micro_bs: int, grad_accum: int,
 
 
 def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
+    from scaletorch_tpu.analysis.memory import accounting_from_compiled
+
     lowered = build_lowered(
         args_ns.model, seq=args_ns.seq, micro_bs=args_ns.bs,
         grad_accum=args_ns.accum, gc=gc, remat_policy=remat_policy,
@@ -172,10 +179,16 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
     # caller's except path records the failure. The size fields below are
     # reported for composition analysis, not re-judged against a budget
     # (donated-argument aliasing makes any client-side sum double-count).
+    # The argument/temp/alias/peak math is the SAME accounting the
+    # jaxlint memory tier gates on (analysis/memory.py) — one
+    # implementation, two consumers.
     compiled = lowered.compile()
-    m = compiled.memory_analysis()
-    arg = m.argument_size_in_bytes
-    peak = arg + m.temp_size_in_bytes + m.generated_code_size_in_bytes
+    acct = accounting_from_compiled(compiled)
+    if acct is None:
+        raise RuntimeError(
+            "compiled.memory_analysis() reported nothing for the AOT "
+            "TPU target — libtpu too old for memory accounting?"
+        )
     try:
         cost = compiled.cost_analysis() or {}
         flops = cost.get("flops")
@@ -192,12 +205,12 @@ def analyze(args_ns, *, gc: bool, remat_policy: str) -> dict:
         **({"pp_engine": args_ns.pp_engine} if args_ns.pp > 1 else {}),
         **({"moe_dispatch": args_ns.moe_dispatch}
            if args_ns.moe_dispatch != "auto" else {}),
-        "argument_gb": round(arg / 1e9, 3),
-        "temp_gb": round(m.temp_size_in_bytes / 1e9, 3),
-        "output_gb": round(m.output_size_in_bytes / 1e9, 3),
-        "alias_gb": round(m.alias_size_in_bytes / 1e9, 3),
-        "code_mb": round(m.generated_code_size_in_bytes / 1e6, 1),
-        "upper_bound_gb": round(peak / 1e9, 3),
+        "argument_gb": round(acct.argument_bytes / 1e9, 3),
+        "temp_gb": round(acct.temp_bytes / 1e9, 3),
+        "output_gb": round(acct.output_bytes / 1e9, 3),
+        "alias_gb": round(acct.alias_bytes / 1e9, 3),
+        "code_mb": round(acct.generated_code_bytes / 1e6, 1),
+        "upper_bound_gb": round(acct.peak_bytes / 1e9, 3),
         "fits_hbm": True,
     }
 
