@@ -128,6 +128,8 @@ register_env("SCALETORCH_TPU_FT_SERVE_DEADLINE_STORM_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_GW_TENANT_STORM_AT", "0", int)
 register_env("SCALETORCH_TPU_FT_GW_TENANT_STORM_COUNT", "8", int)
 register_env("SCALETORCH_TPU_FT_GW_REPLICA_DOWN_AT", "0", int)
+register_env("SCALETORCH_TPU_FT_GW_REPLICA_CRASH_AT", "0", int)
+register_env("SCALETORCH_TPU_FT_GW_REPLICA_HANG_AT", "0", int)
 # Telemetry (scaletorch_tpu/telemetry/): present-wins over the config
 # fields (an explicitly EMPTY dir cancels a config-armed telemetry run).
 register_env("SCALETORCH_TPU_TELEMETRY_DIR", "", str)

@@ -117,6 +117,16 @@ class ServingFaultInjector:
       replica, the router marks that replica dead mid-stream (exit-code
       contract, as if it exited 43/44): its in-flight requests end
       ``aborted``, queued requests re-route to the surviving replicas.
+    * ``gw_replica_crash_at`` — when the k-th request is DISPATCHED,
+      SIGKILL that replica's child process (``worker.kill()``; an
+      in-process worker degrades to thread death). Nothing is
+      announced: the gateway must OBSERVE the crash — the reader
+      threads synthesize the ``aborted`` terminals, the poller flips
+      liveness, the supervisor restarts the child with backoff.
+    * ``gw_replica_hang_at`` — when the k-th request is DISPATCHED,
+      stall that replica's step loop (``worker.stall()``): no ticks,
+      no watchdog beats, so its armed serving watchdog fires exit 44
+      and the supervisor treats it as a crash.
 
     Env overrides (present-wins, the ``env.env_override`` contract
     shared with the training ``FaultInjector``):
@@ -125,7 +135,8 @@ class ServingFaultInjector:
     ``.._SERVE_SUBMIT_STORM_STEP``, ``.._SERVE_SUBMIT_STORM_COUNT``,
     ``.._SERVE_DEADLINE_STORM_STEP``; gateway:
     ``SCALETORCH_TPU_FT_GW_TENANT_STORM_AT``,
-    ``.._GW_TENANT_STORM_COUNT``, ``.._GW_REPLICA_DOWN_AT``.
+    ``.._GW_TENANT_STORM_COUNT``, ``.._GW_REPLICA_DOWN_AT``,
+    ``.._GW_REPLICA_CRASH_AT``, ``.._GW_REPLICA_HANG_AT``.
     """
 
     nan_logits_at_step: int = 0
@@ -138,12 +149,16 @@ class ServingFaultInjector:
     gw_tenant_storm_at: int = 0
     gw_tenant_storm_count: int = 8
     gw_replica_down_at: int = 0
+    gw_replica_crash_at: int = 0
+    gw_replica_hang_at: int = 0
     _nan_fired: bool = field(default=False, repr=False)
     _slow_fired: bool = field(default=False, repr=False)
     _storm_fired: bool = field(default=False, repr=False)
     _deadline_fired: bool = field(default=False, repr=False)
     _gw_storm_fired: bool = field(default=False, repr=False)
     _gw_down_fired: bool = field(default=False, repr=False)
+    _gw_crash_fired: bool = field(default=False, repr=False)
+    _gw_hang_fired: bool = field(default=False, repr=False)
 
     @classmethod
     def from_config(cls, cfg) -> "ServingFaultInjector":
@@ -183,6 +198,12 @@ class ServingFaultInjector:
             gw_replica_down_at=int(env_or(
                 "SCALETORCH_TPU_FT_GW_REPLICA_DOWN_AT",
                 "ft_gw_replica_down_at", 0)),
+            gw_replica_crash_at=int(env_or(
+                "SCALETORCH_TPU_FT_GW_REPLICA_CRASH_AT",
+                "ft_gw_replica_crash_at", 0)),
+            gw_replica_hang_at=int(env_or(
+                "SCALETORCH_TPU_FT_GW_REPLICA_HANG_AT",
+                "ft_gw_replica_hang_at", 0)),
         )
 
     @property
@@ -191,7 +212,9 @@ class ServingFaultInjector:
                     or self.submit_storm_at_step
                     or self.deadline_storm_at_step
                     or self.gw_tenant_storm_at
-                    or self.gw_replica_down_at)
+                    or self.gw_replica_down_at
+                    or self.gw_replica_crash_at
+                    or self.gw_replica_hang_at)
 
     def take_nan_logits(self, step: int) -> Optional[int]:
         """Slot index to poison before decode step ``step``, or None."""
@@ -267,6 +290,37 @@ class ServingFaultInjector:
             get_logger().warning(
                 f"gateway fault injection: marking the routed replica "
                 f"dead at dispatch {dispatch}"
+            )
+            return True
+        return False
+
+    def take_gw_replica_crash(self, dispatch: int) -> bool:
+        """True when the replica receiving the ``dispatch``-th (1-based)
+        routed request must be SIGKILL'd (process fleet) / thread-killed
+        (in-process) — the crash the gateway must survive by observation
+        alone."""
+        if self.gw_replica_crash_at \
+                and dispatch == self.gw_replica_crash_at \
+                and not self._gw_crash_fired:
+            self._gw_crash_fired = True
+            get_logger().warning(
+                f"gateway fault injection: killing the routed replica's "
+                f"process at dispatch {dispatch}"
+            )
+            return True
+        return False
+
+    def take_gw_replica_hang(self, dispatch: int) -> bool:
+        """True when the replica receiving the ``dispatch``-th (1-based)
+        routed request must stall its step loop (the serving watchdog
+        should fire exit 44)."""
+        if self.gw_replica_hang_at \
+                and dispatch == self.gw_replica_hang_at \
+                and not self._gw_hang_fired:
+            self._gw_hang_fired = True
+            get_logger().warning(
+                f"gateway fault injection: stalling the routed replica's "
+                f"step loop at dispatch {dispatch}"
             )
             return True
         return False

@@ -62,6 +62,12 @@ _EXPORTS = {
     "NoReplicaAvailable": "router",
     "PrefixAwareRouter": "router",
     "page_chunk_hashes": "router",
+    # remote replica transport (stdlib at import; the client side pulls
+    # the engine lazily only when it reconstructs a RequestResult)
+    "RemoteEngineWorker": "remote",
+    "ReplicaServer": "remote",
+    # supervisor (stdlib)
+    "ReplicaSupervisor": "supervisor",
     # gateway (pulls the engine, i.e. jax)
     "EngineWorker": "gateway",
     "GatewayMetrics": "gateway",

@@ -197,6 +197,23 @@ class EngineWorker:
 
         self._inbox.put(_do)
 
+    def kill(self) -> None:
+        """The ``gw_replica_crash`` drill on an in-process replica:
+        thread-death semantics (``fail``) stand in for the SIGKILL a
+        ``RemoteEngineWorker`` delivers to its child process."""
+        self.fail("killed (crash drill)")
+
+    def stall(self, seconds: float) -> None:
+        """The ``gw_replica_hang`` drill: wedge the worker loop for
+        ``seconds`` — no ticks, no watchdog beats — so an attached
+        serving watchdog fires (exit 44), exactly like a stalled device
+        dispatch."""
+
+        def _do() -> None:
+            time.sleep(seconds)
+
+        self._inbox.put(_do)
+
     def join(self, timeout: Optional[float] = None) -> None:
         self._thread.join(timeout)
 
@@ -263,6 +280,10 @@ class EngineWorker:
         return self.engine.metrics.snapshot()
 
     @property
+    def page_size(self) -> int:
+        return self.engine.page_size
+
+    @property
     def inflight(self) -> int:
         return len(self._handlers)
 
@@ -325,6 +346,14 @@ class EngineWorker:
                         self._deliver(result)
                     self._notify_tick()
                 elif not self._stop:
+                    # an idle engine runs no step() and so beats no
+                    # watchdog — beat it here, or an armed serving
+                    # watchdog (scripts/replica.py) would count idle
+                    # time as a stall and exit 44 for no reason
+                    watchdog = engine.watchdog
+                    if watchdog is not None:
+                        watchdog.beat(step=engine.metrics.decode_steps,
+                                      phase="idle")
                     try:
                         fn = self._inbox.get(timeout=self.idle_wait_s)
                     except queue.Empty:
@@ -472,7 +501,17 @@ class ServingGateway:
     ----------
     engines : one engine/worker, or ``{replica_id: engine-or-worker}``
         for multi-replica serving. Plain engines are wrapped in
-        ``EngineWorker``s owned (started/joined) by the gateway.
+        ``EngineWorker``s owned (started/joined) by the gateway; any
+        other value is taken as an already-started worker — the
+        in-process ``EngineWorker`` or a ``RemoteEngineWorker`` handle
+        on a replica child process (serving/remote.py).
+    supervisor : optional ``serving.supervisor.ReplicaSupervisor`` over
+        the replica child processes. The gateway wires its exit/restart
+        callbacks: a child exit applies the 0/42/43/44 contract to the
+        router (``report_exit``), a restarted child's fresh worker is
+        swapped in and ``rejoin``ed to routing cold, and /healthz +
+        /metrics surface the per-replica process state (pid, state,
+        restart counters, ``replica_restarts_total{replica=}``).
     router : optional ``PrefixAwareRouter`` (built over the replica ids
         and the first engine's page size when absent).
     tenants / default_weight / max_backlog / free_page_watermark :
@@ -502,8 +541,9 @@ class ServingGateway:
 
     def __init__(
         self,
-        engines: Union[InferenceEngine, EngineWorker,
-                       Dict[str, Union[InferenceEngine, EngineWorker]]],
+        engines: Union[InferenceEngine, EngineWorker, Any,
+                       Dict[str, Union[InferenceEngine, EngineWorker,
+                                       Any]]],
         *,
         host: str = "127.0.0.1",
         port: int = 0,
@@ -518,21 +558,29 @@ class ServingGateway:
         export_every: int = 32,
         tracer: Any = None,
         slo_targets: Optional[Dict[str, Any]] = None,
+        supervisor: Any = None,
     ) -> None:
-        if isinstance(engines, (InferenceEngine, EngineWorker)):
+        if not isinstance(engines, dict):
             engines = {"r0": engines}
         if not engines:
             raise ValueError("gateway needs at least one engine")
-        self.workers: Dict[str, EngineWorker] = {}
+        # a "worker" is anything with the EngineWorker surface — the
+        # in-process thread bridge or a RemoteEngineWorker handle on a
+        # replica child process (serving/remote.py); only bare engines
+        # get wrapped (and owned) here
+        self.workers: Dict[str, Any] = {}
         self._owned_workers: List[EngineWorker] = []
         for rid, eng in engines.items():
-            if isinstance(eng, EngineWorker):
-                self.workers[rid] = eng
-            else:
+            if isinstance(eng, InferenceEngine):
                 worker = EngineWorker(eng, replica_id=rid)
                 self.workers[rid] = worker
                 self._owned_workers.append(worker)
-        page_size = next(iter(self.workers.values())).engine.page_size
+            else:
+                self.workers[rid] = eng
+        page_size = next(
+            (w.page_size for w in self.workers.values()
+             if getattr(w, "page_size", None)), 16)
+        self.supervisor = supervisor
         self.router = router or PrefixAwareRouter(
             list(self.workers), page_size)
         self.admission = AdmissionController(
@@ -563,6 +611,7 @@ class ServingGateway:
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
         self._dispatch_task: Optional[asyncio.Task] = None
+        self._tick_cb: Optional[Callable[[], None]] = None
         self._dispatch_count = 0
         self._closing = False
         self._open_generates = 0  # generate handlers awaiting a terminal
@@ -591,6 +640,25 @@ class ServingGateway:
         if not saw:
             agg["queue_depth"] = float("inf")
         return agg
+
+    def _fleet_headroom(self) -> Dict[str, float]:
+        """Free-page FRACTION per alive replica — the router's
+        headroom signal: when the pools diverge it weights the
+        rendezvous choice toward replicas with room instead of packing
+        by prefix affinity alone (router.route ``headroom=``)."""
+        out: Dict[str, float] = {}
+        for rid, worker in self.workers.items():
+            if not worker.alive:
+                continue
+            snap = worker.gauges()
+            free = snap.get("page_pool_free")
+            used = snap.get("pages_in_use", 0.0)
+            if free is None:
+                continue
+            total = free + used
+            if total > 0:
+                out[rid] = free / total
+        return out
 
     # -- tracing -----------------------------------------------------------
     def _span(self, name: str, **args):
@@ -622,10 +690,17 @@ class ServingGateway:
             except RuntimeError:
                 pass  # loop already closed during shutdown
 
+        self._tick_cb = _on_tick
         for worker in self.workers.values():
             worker.tick_listeners.append(_on_tick)
         for worker in self._owned_workers:
             worker.start()
+        if self.supervisor is not None:
+            # monitor-thread callbacks trampoline onto this loop: child
+            # exits apply the exit-code contract to the router, READY
+            # replacements swap in and rejoin routing cold
+            self.supervisor.on_exit = self._on_replica_exit
+            self.supervisor.on_restart = self._on_replica_restart
         self._dispatch_task = asyncio.ensure_future(self._dispatch_loop())
         self._server = await asyncio.start_server(
             self._handle_connection, self._host, self._requested_port)
@@ -639,6 +714,58 @@ class ServingGateway:
         if self._server is None:
             await self.start()
         await self._server.serve_forever()
+
+    # -- supervisor bridge (monitor thread -> event loop) ------------------
+    def _on_replica_exit(self, replica_id: str, exit_code: int) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(
+                self._apply_replica_exit, replica_id, exit_code)
+        except RuntimeError:
+            pass  # loop closed: shutdown owns the bookkeeping now
+
+    def _apply_replica_exit(self, replica_id: str, exit_code: int) -> None:
+        """Event-loop side of a child exit: the 0/42/43/44 contract
+        applied to routing, the dead worker's poller stopped, and the
+        dispatcher woken so queued work re-routes to survivors."""
+        if replica_id in self.router.replicas:
+            self.router.report_exit(replica_id, exit_code)
+        worker = self.workers.get(replica_id)
+        if worker is not None and hasattr(worker, "stop_polling"):
+            worker.stop_polling()
+        if self._wake is not None:
+            self._wake.set()
+
+    def _on_replica_restart(self, replica_id: str, worker: Any) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+        try:
+            loop.call_soon_threadsafe(
+                self._apply_replica_restart, replica_id, worker)
+        except RuntimeError:
+            pass
+
+    def _apply_replica_restart(self, replica_id: str,
+                               worker: Any) -> None:
+        """Swap the restarted child's fresh worker into the fleet and
+        rejoin it to routing COLD — its radix tree is empty, so the
+        router re-learns its prefixes from scratch (mark_dead dropped
+        the old owner entries when it died)."""
+        if worker is None:
+            return
+        old = self.workers.get(replica_id)
+        if old is not None and hasattr(old, "stop_polling"):
+            old.stop_polling()
+        self.workers[replica_id] = worker
+        if self._tick_cb is not None:
+            worker.tick_listeners.append(self._tick_cb)
+        if replica_id in self.router.replicas:
+            self.router.rejoin(replica_id)
+        if self._wake is not None:
+            self._wake.set()
 
     async def stop(self, *, drain: bool = True,
                    timeout_s: float = 60.0) -> None:
@@ -668,6 +795,8 @@ class ServingGateway:
             # draining workers are still pushing
             await loop.run_in_executor(
                 None, worker.join, max(0.1, deadline - time.monotonic()))
+            if hasattr(worker, "stop_polling"):
+                worker.stop_polling()
         if self._dispatch_task is not None:
             self._wake.set()
             self._dispatch_task.cancel()
@@ -773,6 +902,7 @@ class ServingGateway:
         open_replicas = {
             rid for rid, w in self.workers.items()
             if w.alive and _room(rid, w)}
+        headroom = self._fleet_headroom()
         held = []
         try:
             while open_replicas:
@@ -791,7 +921,8 @@ class ServingGateway:
                     continue
                 try:
                     with self._span("gw.route"):
-                        replica_id = self.router.route(pending.req.prompt)
+                        replica_id = self.router.route(
+                            pending.req.prompt, headroom=headroom)
                 except NoReplicaAvailable:
                     self._finish_local(pending, "rejected",
                                        "no healthy replica")
@@ -814,6 +945,24 @@ class ServingGateway:
                             self._dispatch_count):
                     self.router.mark_dead(replica_id, 44)
                     worker.fail()
+                    open_replicas.discard(replica_id)
+                if self.injector is not None and \
+                        self.injector.take_gw_replica_crash(
+                            self._dispatch_count):
+                    # process-level SIGKILL (in-process workers degrade
+                    # to thread death); the crash is OBSERVED, never
+                    # announced — the reader threads synthesize the
+                    # aborted terminal, the poller/supervisor flip
+                    # liveness and the router learns via report_exit
+                    worker.kill()
+                    open_replicas.discard(replica_id)
+                if self.injector is not None and \
+                        self.injector.take_gw_replica_hang(
+                            self._dispatch_count):
+                    # wedge the replica's step loop: no ticks, no
+                    # watchdog beats — its serving watchdog exits 44
+                    # and the supervisor restarts it with backoff
+                    worker.stall(3600.0)
                     open_replicas.discard(replica_id)
         finally:
             # held requests go back to the FRONT of their tenant queues
@@ -1102,6 +1251,21 @@ class ServingGateway:
                 ({"tenant": t}, c) for t, c in
                 sorted(self.admission.shed_by_tenant.items())],
         })
+        if self.supervisor is not None:
+            status = self.supervisor.status()
+            families.append({
+                "name": "replica_restarts_total", "type": "counter",
+                "samples": [
+                    ({"replica": rid}, s.get("restarts_total", 0))
+                    for rid, s in sorted(status.items())],
+            })
+            families.append({
+                "name": "replica_up", "type": "gauge",
+                "samples": [
+                    ({"replica": rid},
+                     1.0 if s.get("state") == "up" else 0.0)
+                    for rid, s in sorted(status.items())],
+            })
         engine_samples: Dict[str, List] = {}
         for rid, worker in self.workers.items():
             for key, value in worker.gauges().items():
@@ -1150,6 +1314,8 @@ class ServingGateway:
                               keep_alive: bool = False) -> None:
         replicas: Dict[str, Any] = {}
         any_alive = False
+        supervisor_status = (self.supervisor.status()
+                             if self.supervisor is not None else {})
         for rid, worker in self.workers.items():
             snap = worker.gauges() if worker.alive else {}
             any_alive = any_alive or worker.alive
@@ -1161,6 +1327,21 @@ class ServingGateway:
                 "pages_in_use": snap.get("pages_in_use"),
                 "page_pool_free": snap.get("page_pool_free"),
             }
+            # process state: from the supervisor when one runs the
+            # fleet, else whatever the worker itself knows (a remote
+            # worker learns its child's pid from /healthz)
+            proc_state = supervisor_status.get(rid)
+            if proc_state is not None:
+                replicas[rid].update({
+                    "pid": proc_state.get("pid"),
+                    "state": proc_state.get("state"),
+                    "restarts_total": proc_state.get("restarts_total"),
+                    "restarts_consecutive":
+                        proc_state.get("restarts_consecutive"),
+                    "last_exit_code": proc_state.get("last_exit_code"),
+                })
+            elif getattr(worker, "pid", None) is not None:
+                replicas[rid]["pid"] = worker.pid
         healthy = any_alive and not self._closing
         payload = {
             "v": protocol.PROTOCOL_VERSION,

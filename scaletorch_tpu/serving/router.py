@@ -31,6 +31,7 @@ directly.
 from __future__ import annotations
 
 import hashlib
+import math
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
@@ -79,6 +80,28 @@ def _rendezvous(key: str, replicas: Sequence[str]) -> str:
     )
 
 
+def _hash_unit(key: str, replica: str) -> float:
+    """Deterministic uniform in (0, 1) for one (key, replica) pair —
+    the rendezvous hash as a float, for weighting."""
+    digest = hashlib.sha1(f"{key}@{replica}".encode()).digest()
+    return (int.from_bytes(digest[:8], "big") + 1) / float(2**64 + 2)
+
+
+def _weighted_rendezvous(key: str, weights: Dict[str, float]) -> str:
+    """Weighted HRW (Schindelhauer/Schomaker logarithmic method): pick
+    ``argmax -w_r / ln(u_r)``. Reduces to plain rendezvous for equal
+    weights and keeps HRW's minimal-disruption property — only the
+    share proportional to a weight change moves."""
+    best, best_score = None, None
+    for replica, weight in weights.items():
+        u = _hash_unit(key, replica)
+        score = -max(weight, 1e-6) / math.log(u)
+        if best_score is None or score > best_score \
+                or (score == best_score and replica < best):
+            best, best_score = replica, score
+    return best
+
+
 @dataclass
 class ReplicaState:
     """Router-side view of one replica."""
@@ -87,7 +110,9 @@ class ReplicaState:
     healthy: bool = True
     exit_code: Optional[int] = None
     dispatched: int = 0
-    routed_by_prefix: int = 0  # landed via a learned owner-map entry
+    routed_by_prefix: int = 0    # landed via a learned owner-map entry
+    routed_by_headroom: int = 0  # placed by the free-page weighting
+    rejoins: int = 0             # restarts that re-entered rotation
     extra: dict = field(default_factory=dict)
 
 
@@ -109,6 +134,8 @@ class PrefixAwareRouter:
         prefix_aware: bool = True,
         max_tracked_prefixes: int = 65536,
         max_chunks: int = 32,
+        headroom_spread: float = 0.25,
+        headroom_floor: float = 0.10,
     ) -> None:
         if not replica_ids:
             raise ValueError("router needs at least one replica")
@@ -119,6 +146,14 @@ class PrefixAwareRouter:
         self.page_size = page_size
         self.prefix_aware = prefix_aware
         self.max_chunks = max_chunks
+        # page-headroom-aware placement: when the fleet's free-page
+        # fractions diverge by >= headroom_spread, cold prefixes pick
+        # by WEIGHTED rendezvous (weight = free fraction) and a prefix-
+        # affine target squeezed under headroom_floor while another
+        # replica has real room is overridden — affinity must not pack
+        # a replica into page exhaustion while its peers sit empty
+        self.headroom_spread = headroom_spread
+        self.headroom_floor = headroom_floor
         self.replicas: Dict[str, ReplicaState] = {
             rid: ReplicaState(replica_id=rid) for rid in replica_ids}
         # chunk hash -> replica id, LRU-bounded (move_to_end on touch)
@@ -151,6 +186,20 @@ class PrefixAwareRouter:
         for k in stale:
             del self._owners[k]
 
+    def rejoin(self, replica_id: str) -> None:
+        """Return a restarted replica to rotation COLD: its process is
+        new, its radix tree empty, so it re-enters with no owner-map
+        entries (``mark_dead``/``report_exit`` dropped them at death)
+        and the router re-learns its prefixes from live traffic."""
+        st = self.replicas[replica_id]
+        if st.healthy:
+            return
+        st.healthy = True
+        st.exit_code = None
+        st.rejoins += 1
+        logger.info("router: replica %s rejoined rotation cold "
+                    "(%d alive)", replica_id, len(self.alive()))
+
     def report_exit(self, replica_id: str, exit_code: int) -> None:
         """Apply the 0/42/43/44 exit-code contract: 0 is a clean drain
         (the replica leaves rotation without alarm), anything else is a
@@ -168,9 +217,20 @@ class PrefixAwareRouter:
             self.mark_dead(replica_id, exit_code)
 
     # -- routing -----------------------------------------------------------
-    def route(self, prompt: Sequence[int]) -> str:
+    def route(self, prompt: Sequence[int],
+              headroom: Optional[Dict[str, float]] = None) -> str:
         """Pick the replica for one request and learn from the choice.
-        Raises ``NoReplicaAvailable`` when every replica is gone."""
+        Raises ``NoReplicaAvailable`` when every replica is gone.
+
+        ``headroom`` maps replica id -> free-page FRACTION (the
+        ``page_pool_free`` gauge already riding replica metrics). While
+        the fleet is balanced it changes nothing — prefix affinity and
+        plain rendezvous decide. When the pools DIVERGE (spread >=
+        ``headroom_spread``), cold placements weight the rendezvous
+        choice by free fraction, and a prefix-affine target squeezed
+        under ``headroom_floor`` (while some replica still has more
+        than the floor free) is re-placed by the same weighting — a
+        popular prefix must not ride affinity into page exhaustion."""
         alive = self.alive()
         if not alive:
             raise NoReplicaAvailable("no healthy replica in rotation")
@@ -179,8 +239,14 @@ class PrefixAwareRouter:
                               max_chunks=self.max_chunks)
             if self.prefix_aware else []
         )
+        hr = {r: headroom[r] for r in alive
+              if headroom is not None and r in headroom}
+        imbalanced = (len(hr) >= 2
+                      and max(hr.values()) - min(hr.values())
+                      >= self.headroom_spread)
         chosen: Optional[str] = None
         via_prefix = False
+        via_headroom = False
         # deepest learned owner wins: the replica whose tree holds the
         # LONGEST registered prefix of this prompt
         for h in reversed(chain):
@@ -189,6 +255,13 @@ class PrefixAwareRouter:
                 chosen = owner
                 via_prefix = True
                 break
+        if via_prefix and imbalanced \
+                and hr.get(chosen, 1.0) < self.headroom_floor \
+                and max(hr.values()) >= self.headroom_floor:
+            # affinity override: the owner is nearly out of pages and a
+            # peer has real room — a cold prefill beats a shed
+            chosen = None
+            via_prefix = False
         if chosen is None:
             # cold prefix: rendezvous on the FIRST chunk so future
             # requests sharing the head converge without coordination;
@@ -196,11 +269,22 @@ class PrefixAwareRouter:
             # prefix_aware=False keys on the whole prompt always — the
             # consistent-hash-only baseline.
             key = chain[0] if chain else "|".join(str(t) for t in prompt)
-            chosen = _rendezvous(key, alive)
+            if imbalanced:
+                # a replica with no gauge yet (just rejoined, first
+                # poll pending) weighs in at the fleet mean — neither
+                # starved nor flooded until its numbers arrive
+                mean_free = sum(hr.values()) / len(hr)
+                chosen = _weighted_rendezvous(
+                    key, {r: hr.get(r, mean_free) for r in alive})
+                via_headroom = True
+            else:
+                chosen = _rendezvous(key, alive)
         st = self.replicas[chosen]
         st.dispatched += 1
         if via_prefix:
             st.routed_by_prefix += 1
+        if via_headroom:
+            st.routed_by_headroom += 1
         if self.prefix_aware:
             # the chosen replica's tree will hold every full page of
             # this prompt once its prefill registers — learn the chain
@@ -217,12 +301,17 @@ class PrefixAwareRouter:
         alive = self.alive()
         dispatched = sum(s.dispatched for s in self.replicas.values())
         by_prefix = sum(s.routed_by_prefix for s in self.replicas.values())
+        by_headroom = sum(
+            s.routed_by_headroom for s in self.replicas.values())
+        rejoins = sum(s.rejoins for s in self.replicas.values())
         snap: Dict[str, float] = {
             "router_replicas_alive": float(len(alive)),
             "router_replicas_dead": float(
                 len(self.replicas) - len(alive)),
             "router_dispatched": float(dispatched),
             "router_routed_by_prefix": float(by_prefix),
+            "router_routed_by_headroom": float(by_headroom),
+            "router_rejoins": float(rejoins),
             "router_prefix_route_rate": (
                 by_prefix / dispatched if dispatched else 0.0),
             "router_tracked_prefixes": float(len(self._owners)),

@@ -51,7 +51,7 @@ SCHEMA_VERSION = 1
 # Free-form kinds are allowed; these are the ones consumers can rely
 # on. Adding a kind is additive — v stays 1.
 KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics",
-               "access", "latency_histograms")
+               "access", "latency_histograms", "supervisor")
 
 
 class TelemetryExporter:

@@ -30,10 +30,23 @@ Artifacts land in ``$GATEWAY_SMOKE_TELEMETRY`` (default
 on them again as a separate blocking step.
 
 Exit 0 = all green; any assertion prints a diagnostic and exits 1.
+
+``--procs N`` (the CI ``gateway-smoke-mp`` step) switches to the
+PROCESS-FLEET drill instead: boot ``serve.py --serve_replica_procs N``
+with ``--ft_gw_replica_crash_at 1`` armed, so the replica serving the
+FIRST request is SIGKILLed mid-stream — the stream must still end in
+exactly one terminal (``aborted``), the supervisor must restart the
+child (new pid on ``/healthz``, ``replica_restarts_total`` bumped), a
+follow-up request must stream bit-identical tokens to the direct
+engine, the /metrics ledger must balance THROUGH the crash
+(``http_requests_received == sum(outcomes)``), SIGTERM must drain the
+whole fleet to exit 0, and the supervisor's JSONL event stream
+(spawn/ready/crash/restart) plus slo_check must hold on the artifacts.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import queue
@@ -174,6 +187,165 @@ def run_slo_check(events_path: str, prom_path: str) -> None:
     print("[smoke] slo_check OK (JSONL + /metrics scrape)")
 
 
+def stream_generate(base: str, *, timeout: float = 120.0):
+    """POST one streaming request with the known traceparent; return
+    (events, streamed_tokens, dones, traceparent_echo)."""
+    from scaletorch_tpu.serving.protocol import (
+        parse_sse_stream,
+        stream_tokens,
+    )
+
+    body = json.dumps({"prompt": PROMPT, "max_new_tokens": MAX_NEW,
+                       "stream": True}).encode()
+    request = urllib.request.Request(
+        f"{base}/v1/generate", data=body, method="POST")
+    request.add_header("traceparent", f"00-{TRACE_ID}-{PARENT_SPAN}-01")
+    response = urllib.request.urlopen(request, timeout=timeout)
+    echo = response.headers.get("traceparent", "")
+    events = parse_sse_stream(response.read())
+    dones = [d for e, d in events if e == "done"]
+    return events, stream_tokens(events), dones, echo
+
+
+def parse_prom(text: str) -> dict:
+    """Flat ``{series-with-labels: value}`` out of an exposition page."""
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, _, value = line.rpartition(" ")
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def main_mp(procs: int) -> int:
+    """The process-fleet drill: kill -9 mid-stream, survive, heal."""
+    if os.path.isdir(TELEMETRY_DIR):
+        shutil.rmtree(TELEMETRY_DIR)
+    os.makedirs(TELEMETRY_DIR, exist_ok=True)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
+         *SERVE_ARGS,
+         "--serve_replica_procs", str(procs),
+         "--ft_gw_replica_crash_at", "1",
+         "--supervisor_backoff_base_s", "0.2",
+         "--supervisor_backoff_max_s", "1.0"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, cwd=REPO,
+    )
+    try:
+        lines = pump_output(proc)
+        port = wait_ready(lines, proc, timeout_s=300.0)
+        base = f"http://127.0.0.1:{port}"
+
+        health = json.loads(
+            urllib.request.urlopen(f"{base}/healthz", timeout=30).read())
+        pids_before = {rid: rep["pid"]
+                       for rid, rep in health["replicas"].items()}
+        assert len(pids_before) == procs, health
+        assert all(isinstance(p, int) for p in pids_before.values()), \
+            health
+
+        # 1. the armed drill SIGKILLs the serving replica mid-stream:
+        #    the stream must still end in EXACTLY ONE terminal
+        _, streamed, dones, _ = stream_generate(base)
+        assert len(dones) == 1, f"want exactly one done event: {dones}"
+        assert dones[0]["outcome"] == "aborted", dones[0]
+        assert streamed == dones[0]["token_ids"], (streamed, dones[0])
+        print("[smoke-mp] kill -9 mid-stream -> exactly one terminal "
+              f"(aborted, {len(streamed)} partial tokens) OK")
+
+        # 2. the supervisor restarts the victim: new pid, counter bumped
+        deadline = time.monotonic() + 300
+        victim = None
+        while time.monotonic() < deadline:
+            health = json.loads(urllib.request.urlopen(
+                f"{base}/healthz", timeout=30).read())
+            restarted = {
+                rid: rep for rid, rep in health["replicas"].items()
+                if rep.get("restarts_total", 0) >= 1
+                and rep.get("state") == "up"}
+            if restarted:
+                victim = next(iter(restarted))
+                break
+            time.sleep(0.5)
+        assert victim is not None, f"no replica restarted: {health}"
+        rep = health["replicas"][victim]
+        assert rep["pid"] != pids_before[victim], (rep, pids_before)
+        assert rep["last_exit_code"] not in (None, 0), rep
+        print(f"[smoke-mp] supervisor restarted {victim}: "
+              f"pid {pids_before[victim]} -> {rep['pid']}, "
+              f"exit {rep['last_exit_code']} OK")
+
+        # 3. the healed fleet streams BIT-IDENTICAL tokens
+        _, streamed, dones, echo = stream_generate(base)
+        assert len(dones) == 1 and dones[0]["outcome"] == "ok", dones
+        assert echo.startswith(f"00-{TRACE_ID}-"), echo
+        reference = direct_engine_tokens()
+        assert streamed == reference, (
+            f"post-restart stream diverged:\n"
+            f"  streamed:  {streamed}\n  reference: {reference}")
+        print(f"[smoke-mp] post-restart SSE bit-parity OK over "
+              f"{len(streamed)} tokens")
+
+        # 4. the ledger balances THROUGH the crash
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30).read().decode()
+        prom = parse_prom(metrics)
+        received = prom["scaletorch_http_requests_received"]
+        outcome_sum = sum(
+            v for k, v in prom.items()
+            if k.startswith("scaletorch_http_")
+            and k.split("scaletorch_http_", 1)[1] in (
+                "ok", "timeout", "shed", "rejected", "quarantined",
+                "aborted"))
+        assert received == 2.0, received
+        assert outcome_sum == received, (outcome_sum, received, prom)
+        assert prom["scaletorch_http_aborted"] == 1.0, prom
+        assert prom["scaletorch_http_ok"] == 1.0, prom
+        restarts = [v for k, v in prom.items()
+                    if k.startswith("scaletorch_replica_restarts_total")]
+        assert restarts and sum(restarts) >= 1.0, prom
+        ups = [v for k, v in prom.items()
+               if k.startswith("scaletorch_replica_up")]
+        assert len(ups) == procs and all(u == 1.0 for u in ups), prom
+        prom_path = os.path.join(TELEMETRY_DIR, "metrics_scrape.txt")
+        with open(prom_path, "w") as f:
+            f.write(metrics)
+        print("[smoke-mp] conservation through the crash OK "
+              f"(received={received:g} == outcomes={outcome_sum:g}; "
+              f"restarts={sum(restarts):g})")
+
+        # 5. SIGTERM drains the WHOLE fleet to exit 0
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=180)
+        assert rc == 0, f"drain exit code {rc}, want 0"
+        print("[smoke-mp] SIGTERM fleet drain exit 0 OK")
+
+        # 6. post-mortem: supervisor JSONL events + access + slo gates
+        events_path = os.path.join(TELEMETRY_DIR, "gateway_events.jsonl")
+        records = [json.loads(line) for line in open(events_path)]
+        sup_events = [r["event"] for r in records
+                      if r.get("kind") == "supervisor"]
+        for needed in ("spawn", "ready", "crash", "restart"):
+            assert needed in sup_events, (needed, sup_events)
+        access = [r for r in records if r.get("kind") == "access"]
+        assert len(access) == 2, access
+        assert sorted(r["outcome"] for r in access) == \
+            ["aborted", "ok"], access
+        print(f"[smoke-mp] supervisor event stream OK ({sup_events})")
+        run_slo_check(events_path, prom_path)
+        return 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+
 def main() -> int:
     if os.path.isdir(TELEMETRY_DIR):
         shutil.rmtree(TELEMETRY_DIR)  # stale artifacts must not pass
@@ -263,4 +435,10 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--procs", type=int, default=0,
+                    help="N >= 2: run the process-fleet crash drill "
+                         "(serve.py --serve_replica_procs N) instead of "
+                         "the single-process smoke.")
+    cli = ap.parse_args()
+    sys.exit(main_mp(cli.procs) if cli.procs > 0 else main())

@@ -29,6 +29,14 @@
 # script relaunches the whole fleet (every process must restart together:
 # the surviving processes of a wedged collective are not salvageable).
 # Exit 42 (training diverged) is NOT restarted — it needs a human.
+#
+# This restart loop is TRAINING-ONLY. Serving replicas share no
+# collective, so their supervision lives in
+# scaletorch_tpu/serving/supervisor.py (scripts/serve.py
+# --serve_replica_procs N): same exit codes, but replicas restart
+# INDEPENDENTLY with per-replica backoff and flap detection instead of
+# fleet-wide relaunch. The two policies are cross-referenced in
+# docs/fault_tolerance.md's exit-code table so they cannot drift.
 
 set -euo pipefail
 

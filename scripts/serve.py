@@ -60,6 +60,20 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--serve_host", default="127.0.0.1")
     p.add_argument("--serve_port", type=int, default=8000)
     p.add_argument("--serve_replicas", type=int, default=1)
+    p.add_argument("--serve_replica_procs", type=int, default=0,
+                   help="> 0: run N replicas as CHILD PROCESSES "
+                        "(scripts/replica.py each) behind the replica "
+                        "supervisor — independent failure domains with "
+                        "auto-restart — instead of --serve_replicas "
+                        "in-process worker threads.")
+    p.add_argument("--replica_watchdog_timeout_s", type=float,
+                   default=120.0,
+                   help="Each replica child's serving stall watchdog "
+                        "(exit 44); <= 0 disarms it.")
+    p.add_argument("--supervisor_backoff_base_s", type=float, default=0.5)
+    p.add_argument("--supervisor_backoff_max_s", type=float, default=30.0)
+    p.add_argument("--supervisor_flap_window_s", type=float, default=60.0)
+    p.add_argument("--supervisor_flap_max_restarts", type=int, default=5)
     p.add_argument("--serve_tenants", default="",
                    help="'name:weight[:rate[:burst]],...' "
                         "(config.ServingArguments grammar)")
@@ -84,6 +98,13 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ft_gw_tenant_storm_at", type=int, default=0)
     p.add_argument("--ft_gw_tenant_storm_count", type=int, default=8)
     p.add_argument("--ft_gw_replica_down_at", type=int, default=0)
+    p.add_argument("--ft_gw_replica_crash_at", type=int, default=0,
+                   help="SIGKILL the replica serving the k-th dispatch "
+                        "(process mode; in-process degrades to thread "
+                        "death).")
+    p.add_argument("--ft_gw_replica_hang_at", type=int, default=0,
+                   help="Stall the replica serving the k-th dispatch "
+                        "so its watchdog exits 44.")
     return p.parse_args(argv)
 
 
@@ -127,16 +148,72 @@ def build_engine(args, cfg, params, tracer=None):
     )
 
 
+def make_replica_spawner(args):
+    """``(replica_id) -> Popen`` launching scripts/replica.py with this
+    serve invocation's model/engine flags — the supervisor's spawn_fn.
+    stdout is piped (the supervisor reads ``READY port=``), stderr is
+    inherited so replica logs land in the parent's stream."""
+    import subprocess
+
+    replica_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "replica.py")
+
+    def spawn(replica_id: str):
+        cmd = [sys.executable, replica_py,
+               "--preset", args.preset,
+               "--param_seed", str(args.param_seed),
+               "--max_slots", str(args.max_slots),
+               "--max_seq", str(args.max_seq),
+               "--prefill_len", str(args.prefill_len),
+               "--cache_layout", args.cache_layout,
+               "--page_size", str(args.page_size),
+               "--replica_id", replica_id,
+               "--port", "0",
+               "--watchdog_timeout_s",
+               str(args.replica_watchdog_timeout_s)]
+        if args.model_name_or_path:
+            cmd += ["--model_name_or_path", args.model_name_or_path]
+        return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
+
+    return spawn
+
+
+def build_replica_fleet(args, exporter=None):
+    """Process mode: spawn ``--serve_replica_procs`` replica children
+    under a ``ReplicaSupervisor``, each fronted by a
+    ``RemoteEngineWorker``. Returns ``(workers, supervisor)``."""
+    from scaletorch_tpu.serving.remote import RemoteEngineWorker
+    from scaletorch_tpu.serving.supervisor import ReplicaSupervisor
+
+    def worker_factory(replica_id: str, port: int, proc):
+        return RemoteEngineWorker(
+            "127.0.0.1", port, replica_id=replica_id, proc=proc).start()
+
+    supervisor = ReplicaSupervisor(
+        make_replica_spawner(args),
+        [f"r{i}" for i in range(args.serve_replica_procs)],
+        worker_factory=worker_factory,
+        backoff_base_s=args.supervisor_backoff_base_s,
+        backoff_max_s=args.supervisor_backoff_max_s,
+        flap_window_s=args.supervisor_flap_window_s,
+        flap_max_restarts=args.supervisor_flap_max_restarts,
+        exporter=exporter,
+    )
+    workers = supervisor.start()
+    return workers, supervisor
+
+
 def build_gateway(args):
     from scaletorch_tpu.inference.resilience import ServingFaultInjector
     from scaletorch_tpu.serving.admission import parse_tenant_spec
     from scaletorch_tpu.serving.gateway import ServingGateway
 
-    cfg, params = build_model(args)
     # ONE tracer shared by the gateway and every replica engine: the
     # asyncio thread, the EngineWorker threads and the tick loops all
     # write the same Chrome trace, so one Perfetto load shows a request
-    # crossing all of them, correlated by trace id
+    # crossing all of them, correlated by trace id. (Process-mode
+    # replicas live in other processes — the trace covers the gateway
+    # side only there.)
     tracer = None
     exporter = None
     if args.telemetry_dir:
@@ -154,13 +231,19 @@ def build_gateway(args):
 
         slo_targets = preset_targets(load_slo(args.slo_path),
                                      args.slo_preset)
-    engines = {
-        f"r{i}": build_engine(args, cfg, params, tracer=tracer)
-        for i in range(args.serve_replicas)
-    }
+    supervisor = None
+    if args.serve_replica_procs > 0:
+        engines, supervisor = build_replica_fleet(args, exporter=exporter)
+    else:
+        cfg, params = build_model(args)
+        engines = {
+            f"r{i}": build_engine(args, cfg, params, tracer=tracer)
+            for i in range(args.serve_replicas)
+        }
     injector = ServingFaultInjector.from_config(args)
     return ServingGateway(
         engines,
+        supervisor=supervisor,
         host=args.serve_host, port=args.serve_port,
         tenants=parse_tenant_spec(args.serve_tenants),
         default_weight=args.serve_default_weight,
@@ -190,12 +273,19 @@ def make_snapshotter(args, gateway):
                 rid: {
                     "alive": worker.alive,
                     "metrics": worker.gauges(),
-                    "histograms":
-                        worker.engine.metrics.histogram_state(),
+                    # remote workers have no in-process engine: their
+                    # histogram state lives in the child; the gauges
+                    # above are the polled snapshot
+                    "histograms": (
+                        worker.engine.metrics.histogram_state()
+                        if getattr(worker, "engine", None) is not None
+                        else None),
                 }
                 for rid, worker in gateway.workers.items()
             },
         }
+        if gateway.supervisor is not None:
+            payload["supervisor"] = gateway.supervisor.status()
         if gateway.tracer is not None:
             payload["span_timeline_tail"] = gateway.tracer.tail(128)
         return payload
@@ -219,6 +309,11 @@ async def _main(args) -> int:
     await stop.wait()
     print("draining gateway...", flush=True)
     await gateway.stop(drain=True)
+    if gateway.supervisor is not None:
+        # the drain above already made every replica exit 0 ("drained",
+        # never restarted); this reaps the children and the monitor
+        await loop.run_in_executor(
+            None, lambda: gateway.supervisor.stop(drain=True))
     serve.cancel()
     if snapshotter is not None:
         snapshotter.uninstall()

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""A jax-free replica double: scripted engine worker + the REAL wire.
+
+The process-fleet tests (test_remote.py, test_supervisor.py) need child
+processes that boot in milliseconds, stream deterministic tokens, obey
+cancel/drain/stall, and die on command — without paying a jax import or
+a compile per child. ``FakeEngineWorker`` is an ``EngineWorker``-shaped
+double (same duck surface ``ReplicaServer`` documents); run as a script
+this module is a drop-in stand-in for ``scripts/replica.py``: it binds
+a real ``ReplicaServer``, prints ``READY port=<n>``, drains to exit 0
+on SIGTERM, and honors the test-only crash hooks:
+
+  --selfcrash_after_s S --selfcrash_code C   os._exit(C) S seconds
+                                             after boot (deterministic
+                                             crash-family exits without
+                                             racing a kill -9)
+  --token_delay_s D                          per-token decode latency
+                                             (stretch streams so a test
+                                             can kill mid-flight)
+
+Token stream is a pure function of the prompt: token i is
+``(sum(prompt) + i) % vocab`` — any observer can recompute the expected
+stream, so conservation tests can also assert payload integrity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from types import SimpleNamespace
+
+sys.path.insert(
+    0,
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))))
+
+
+class FakeEngineWorker:
+    """EngineWorker-shaped double: one thread per request, no jax.
+
+    Matches the surface ``ReplicaServer`` (and the gateway dispatcher)
+    relies on: ``submit``/``cancel``/``gauges``/``stall``/``alive``/
+    ``inflight``/``page_size``/``shutdown``/``join``/``tick_listeners``.
+    """
+
+    def __init__(self, *, token_delay_s: float = 0.005,
+                 vocab: int = 101, page_size: int = 16,
+                 page_pool: int = 32) -> None:
+        self.alive = True
+        self.exit_code = None
+        self.page_size = page_size
+        self.page_pool = page_pool
+        self.vocab = vocab
+        self.token_delay_s = token_delay_s
+        self.tick_listeners = []
+        self.draining = False
+        self._stall_until = 0.0
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._live = set()
+        self._cancelled = {}
+
+    # -- observability ------------------------------------------------------
+    @property
+    def inflight(self) -> int:
+        with self._lock:
+            return len(self._live)
+
+    def gauges(self):
+        with self._lock:
+            live = len(self._live)
+        return {
+            "queue_depth": 0.0,
+            "slot_occupancy": live / 4.0,
+            "pages_in_use": float(live),
+            "page_pool_free": float(self.page_pool - live),
+        }
+
+    # -- control ------------------------------------------------------------
+    def stall(self, seconds: float) -> None:
+        self._stall_until = time.monotonic() + seconds
+
+    def cancel(self, request_id: int, detail: str) -> None:
+        with self._lock:
+            if request_id in self._live:
+                self._cancelled[request_id] = detail
+
+    def shutdown(self, *, drain: bool = True) -> None:
+        self.draining = True
+
+    def join(self, timeout=None) -> None:
+        deadline = (time.monotonic() + timeout) if timeout else None
+        while self.inflight > 0 and (
+                deadline is None or time.monotonic() < deadline):
+            time.sleep(0.005)
+
+    def expected_tokens(self, prompt, n):
+        base = sum(prompt) % self.vocab
+        return [(base + i) % self.vocab for i in range(n)]
+
+    # -- the request path ---------------------------------------------------
+    def submit(self, req, on_tokens, on_done, *, ttl_s=None,
+               on_submitted=None) -> None:
+        with self._lock:
+            rid = self._next_id
+            self._next_id += 1
+            self._live.add(rid)
+        threading.Thread(
+            target=self._run,
+            args=(rid, req, on_tokens, on_done, on_submitted),
+            name=f"fake-req-{rid}", daemon=True).start()
+
+    def _run(self, rid, req, on_tokens, on_done, on_submitted) -> None:
+        if on_submitted is not None:
+            on_submitted(rid)
+        tokens = []
+        outcome, reason, detail = "ok", "length", None
+        for tok in self.expected_tokens(req.prompt, req.max_new_tokens):
+            while time.monotonic() < self._stall_until:
+                time.sleep(0.01)
+            time.sleep(self.token_delay_s)
+            with self._lock:
+                cancel_detail = self._cancelled.pop(rid, None)
+            if cancel_detail is not None:
+                outcome, reason, detail = "aborted", "aborted", cancel_detail
+                break
+            tokens.append(tok)
+            on_tokens(rid, [tok])
+            if req.eos_id is not None and tok == req.eos_id:
+                reason = "eos"
+                break
+        with self._lock:
+            self._live.discard(rid)
+            self._cancelled.pop(rid, None)
+        on_done(SimpleNamespace(
+            request_id=rid, prompt=list(req.prompt), tokens=tokens,
+            finish_reason=reason, outcome=outcome, detail=detail,
+            ttft_s=None, latency_s=None, queue_wait_s=0.0,
+            prefill_s=0.0, prefix_hit=False, trace_id=req.trace_id))
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--replica_id", default="r0")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--token_delay_s", type=float, default=0.005)
+    p.add_argument("--drain_timeout_s", type=float, default=10.0)
+    p.add_argument("--selfcrash_after_s", type=float, default=0.0)
+    p.add_argument("--selfcrash_code", type=int, default=42)
+    return p.parse_args(argv)
+
+
+async def _serve(args, worker) -> None:
+    import asyncio
+    import signal
+
+    from scaletorch_tpu.serving.remote import ReplicaServer
+
+    server = ReplicaServer(worker, host=args.host, port=args.port)
+    await server.start()
+    print(f"READY port={server.port}", flush=True)
+    if args.selfcrash_after_s > 0:
+        # armed AFTER READY so the crash clock never races the boot
+        timer = threading.Timer(
+            args.selfcrash_after_s,
+            lambda: os._exit(args.selfcrash_code))
+        timer.daemon = True
+        timer.start()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, server.request_drain)
+    await server.wait_drain()
+    worker.shutdown(drain=True)
+    deadline = time.monotonic() + args.drain_timeout_s
+    while worker.inflight > 0 and time.monotonic() < deadline:
+        await asyncio.sleep(0.01)
+    await server.close()
+
+
+def main(argv=None) -> int:
+    import asyncio
+
+    args = parse_args(argv)
+    worker = FakeEngineWorker(token_delay_s=args.token_delay_s)
+    asyncio.run(_serve(args, worker))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

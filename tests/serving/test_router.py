@@ -101,6 +101,99 @@ class TestRouterMembership:
         assert router.snapshot()["router_tracked_prefixes"] <= 8
 
 
+class TestHeadroomRouting:
+    """Page-headroom-aware placement: weight cold rendezvous by free-
+    page fraction when the pools diverge; never let prefix affinity
+    pack a replica into exhaustion; rejoin restarted replicas cold."""
+
+    def test_balanced_fleet_is_a_noop(self):
+        plain = PrefixAwareRouter(["r0", "r1"], PAGE)
+        aware = PrefixAwareRouter(["r0", "r1"], PAGE)
+        hr = {"r0": 0.50, "r1": 0.62}  # spread < headroom_spread
+        for i in range(100):
+            prompt = [i, i + 1, i + 2]
+            assert aware.route(prompt, headroom=hr) == plain.route(prompt)
+        assert aware.snapshot()["router_routed_by_headroom"] == 0.0
+
+    def test_imbalanced_cold_placement_follows_free_pages(self):
+        router = PrefixAwareRouter(["r0", "r1"], PAGE)
+        hr = {"r0": 0.05, "r1": 0.95}
+        for i in range(200):
+            router.route([1000 + i] * 8, headroom=hr)
+        snap = router.snapshot()
+        assert snap["router_routed_by_headroom"] > 0.0
+        starved = router.replicas["r0"].dispatched
+        free = router.replicas["r1"].dispatched
+        assert free > 10 * starved, (starved, free)
+
+    def test_affinity_override_only_below_floor(self):
+        router = PrefixAwareRouter(["r0", "r1"], PAGE)
+        owned = []
+        for i in range(400):
+            prompt = [2000 + i] * 8
+            if router.route(prompt) == "r0":
+                owned.append(prompt)
+        assert len(owned) > 50
+        # owner squeezed but still above the floor: affinity HOLDS
+        # (spread 0.83 >= 0.25, so the fleet counts as imbalanced)
+        hr = {"r0": 0.12, "r1": 0.95}
+        for prompt in owned:
+            assert router.route(prompt, headroom=hr) == "r0"
+        # owner under the floor while the peer has room: most owned
+        # prefixes are re-placed by the free-page weighting (weight
+        # 0.02 vs 0.95 leaves a sliver on the owner — that's the point
+        # of weighted rendezvous, not a bug)
+        hr = {"r0": 0.02, "r1": 0.95}
+        moved = sum(router.route(p, headroom=hr) == "r1" for p in owned)
+        assert moved >= 0.9 * len(owned), (moved, len(owned))
+
+    def test_missing_gauge_weighs_in_at_fleet_mean(self):
+        # r2 just rejoined: no gauge yet. It must get real traffic
+        # (mean weight), not be starved at the 1e-6 floor.
+        router = PrefixAwareRouter(["r0", "r1", "r2"], PAGE)
+        hr = {"r0": 0.9, "r1": 0.1}
+        for i in range(300):
+            router.route([3000 + i] * 8, headroom=hr)
+        assert router.replicas["r2"].dispatched > 20
+
+    def test_rejoin_is_cold_and_counted(self):
+        router = PrefixAwareRouter(["r0", "r1"], PAGE)
+        prompt = [9] * 8
+        owner = router.route(prompt)
+        router.mark_dead(owner, exit_code=44)
+        survivor = router.route(prompt)
+        assert survivor != owner
+        router.rejoin(owner)
+        assert sorted(router.alive()) == ["r0", "r1"]
+        assert router.replicas[owner].exit_code is None
+        # cold: the survivor LEARNED the prefix while the owner was
+        # down, so affinity stays with the survivor after the rejoin
+        assert router.route(prompt) == survivor
+        assert router.snapshot()["router_rejoins"] == 1.0
+        router.rejoin(owner)  # idempotent on a healthy replica
+        assert router.snapshot()["router_rejoins"] == 1.0
+
+    def test_weighted_rendezvous_minimal_disruption(self):
+        from scaletorch_tpu.serving.router import _weighted_rendezvous
+
+        keys = [f"k{i}" for i in range(400)]
+        before = {k: _weighted_rendezvous(k, {"a": 1.0, "b": 1.0})
+                  for k in keys}
+        # doubling b's weight may only move keys TOWARD b
+        after = {k: _weighted_rendezvous(k, {"a": 1.0, "b": 2.0})
+                 for k in keys}
+        moved = [k for k in keys if before[k] != after[k]]
+        assert moved, "weight change must move some share"
+        assert all(after[k] == "b" for k in moved)
+        # equal weights spread roughly evenly
+        share_a = sum(v == "a" for v in before.values()) / len(keys)
+        assert 0.35 < share_a < 0.65
+        # determinism
+        assert all(
+            _weighted_rendezvous(k, {"a": 1.0, "b": 2.0}) == after[k]
+            for k in keys[:50])
+
+
 def _run_schedule(tiny_llama, prefix_aware: bool, schedule):
     """Route + serve a schedule over two fresh replicas; return the
     aggregate (prefix_hit_rate, prefill_tokens_saved, cold_prefill_tokens)."""
