@@ -130,6 +130,10 @@ register_env("SCALETORCH_TPU_FT_GW_TENANT_STORM_COUNT", "8", int)
 register_env("SCALETORCH_TPU_FT_GW_REPLICA_DOWN_AT", "0", int)
 register_env("SCALETORCH_TPU_FT_GW_REPLICA_CRASH_AT", "0", int)
 register_env("SCALETORCH_TPU_FT_GW_REPLICA_HANG_AT", "0", int)
+# Warm-rejoin drills (serving/remote.py donor side; the counting unit is
+# 1-based warm-transfer chunks on the /warm stream).
+register_env("SCALETORCH_TPU_FT_GW_WARM_DONOR_CRASH_AT", "0", int)
+register_env("SCALETORCH_TPU_FT_GW_WARM_CORRUPT_CHUNK_AT", "0", int)
 # Telemetry (scaletorch_tpu/telemetry/): present-wins over the config
 # fields (an explicitly EMPTY dir cancels a config-armed telemetry run).
 register_env("SCALETORCH_TPU_TELEMETRY_DIR", "", str)

@@ -168,20 +168,30 @@ def make_fill_slots_step(*, donate_cache: Optional[bool] = None) -> Callable:
     serves both layouts (the engine clears whole slots dense, whole
     pages paged).
 
-    One compile serves both consumers — quarantine hygiene (value 0:
-    a retired poison slot's NaN K/V must not outlive the request) and
-    fault injection (value NaN: poison a slot's cache lines so its next
-    decode step goes non-finite) — because the mask and the fill value
-    are data, never shapes. The cache is donated like the engine steps,
-    so XLA rewrites the masked lanes in place.
+    One compile serves the scalar consumers — quarantine hygiene
+    (value 0: a retired poison slot's NaN K/V must not outlive the
+    request) and fault injection (value NaN: poison a slot's cache
+    lines so its next decode step goes non-finite) — because the mask
+    and the fill value are data, never shapes. ``value`` may also be a
+    cache-shaped tuple (one buffer per cache field): the warm-rejoin
+    import writes transferred page CONTENTS through this same step —
+    masked pages take the tuple's bytes, unmasked pages pass through
+    bit-identical. That is a second argument STRUCTURE, hence a second
+    specialization of this function only; the decode/prefill entries
+    the deep-tier audit pins never retrace. The cache is donated like
+    the engine steps, so XLA rewrites the masked lanes in place.
     """
 
     def fill_slots(cache, mask, value):
-        def fill(buf):
-            m = mask.reshape((1, mask.shape[0]) + (1,) * (buf.ndim - 2))
-            return jnp.where(m, jnp.asarray(value, buf.dtype), buf)
+        vals = tuple(value) if isinstance(value, tuple) \
+            else (value,) * len(cache)
 
-        return type(cache)(*(fill(buf) for buf in cache))
+        def fill(buf, val):
+            m = mask.reshape((1, mask.shape[0]) + (1,) * (buf.ndim - 2))
+            return jnp.where(m, jnp.asarray(val, buf.dtype), buf)
+
+        return type(cache)(*(fill(buf, val)
+                             for buf, val in zip(cache, vals)))
 
     return jax.jit(
         fill_slots,

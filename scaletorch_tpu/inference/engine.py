@@ -49,7 +49,7 @@ import itertools
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -158,6 +158,12 @@ class EngineMetrics:
     page_pool_free: int = 0
     prefix_hits: int = 0
     prefill_tokens_saved: int = 0
+    # warm-rejoin accounting: ``prefix_pages`` gauges the radix tree's
+    # registered page count (the donor-selection signal the gateway
+    # ranks peers by); ``warm_pages_total`` counts pages this engine
+    # imported from peers since boot
+    prefix_pages: int = 0
+    warm_pages_total: int = 0
     ttft_sum_s: float = 0.0
     ttft_count: int = 0
     outcomes: Dict[str, int] = field(
@@ -228,6 +234,8 @@ class EngineMetrics:
                 if self.requests_admitted else 0.0
             ),
             "prefill_tokens_saved": self.prefill_tokens_saved,
+            "prefix_pages": self.prefix_pages,
+            "warm_pages_total": self.warm_pages_total,
         }
         for outcome, count in self.outcomes.items():
             snap[f"requests_{outcome}"] = count
@@ -518,6 +526,8 @@ class InferenceEngine:
     def _update_page_gauges(self) -> None:
         self.metrics.pages_in_use = self.allocator.used_count
         self.metrics.page_pool_free = self.allocator.free_count
+        self.metrics.prefix_pages = (
+            len(self.radix) if self.radix is not None else 0)
 
     def _tables_device(self):
         """The page tables as a device array, uploaded once per host
@@ -830,6 +840,185 @@ class InferenceEngine:
         self.cache = self._fill_slots(
             self.cache, jnp.asarray(mask),
             jnp.asarray(float("nan"), jnp.float32))
+
+    # ---- warm rejoin: peer-to-peer prefix state exchange -----------------
+    #
+    # A restarted replica rejoins with an empty radix tree; these three
+    # methods are the engine half of warming it from a live peer. The
+    # donor side (`export_prefix_map` / `export_prefix_pages`) is a pure
+    # read plus a refcount-retained host copy — donor conservation is
+    # untouched and the wire streams from host memory, so a slow
+    # recipient can never pin (or evict) donor pool pages. The recipient
+    # side (`import_prefix_pages`) allocates pool pages, writes the
+    # transferred bytes through the SAME jitted fill step quarantine
+    # uses (a cache-shaped value is a new argument structure of
+    # `fill_slots` only — `decode_compile_count == 1` holds through
+    # warming), registers the chains frozen-from-birth (the tree holds
+    # the single reference, so a warmed page is evictable-at-zero like
+    # any cached prefix), and releases every allocation in a `finally`
+    # so an interrupted import leaves the allocator conservation oracle
+    # green.
+
+    def export_prefix_map(self) -> Dict[str, Any]:
+        """Snapshot the radix tree for a warming peer: root-to-leaf
+        token chains with their page ids, plus per-page refcount/frozen
+        state. Engine-thread only (worker inbox)."""
+        if not self._paged or self.radix is None:
+            return {"page_size": self.page_size if self._paged else None,
+                    "chains": [], "pages": {}}
+        return {
+            "page_size": self.page_size,
+            "dtype": str(self.cache.k.dtype),
+            "page_shape": ([int(self.cache.k.shape[0])]
+                           + [int(d) for d in self.cache.k.shape[2:]]),
+            "chains": [
+                {"tokens": [int(t) for t in tokens],
+                 "pages": [int(p) for p in pages]}
+                for tokens, pages in self.radix.chains()],
+            "pages": {
+                int(p): {"refcount": self.allocator.refcount(p),
+                         "frozen": True}
+                for p in self.radix.registered_pages()},
+            "capacity": self.allocator.capacity,
+            "free": self.allocator.free_count,
+        }
+
+    def export_prefix_pages(
+        self, pages: Sequence[int]
+    ) -> Tuple[Dict[str, Any], Dict[int, Tuple[bytes, bytes]]]:
+        """Copy the requested FROZEN pages' K/V bytes to host memory.
+
+        Only radix-registered pages ship (anything else is mutable slot
+        state); each is refcount-retained across the device->host copy
+        and released immediately after, so the donor keeps serving and
+        its conservation invariant never moves. Returns ``(meta,
+        {page: (k_bytes, v_bytes)})``; requested pages no longer frozen
+        are simply absent (the wire sends a zero-content frame)."""
+        meta: Dict[str, Any] = {
+            "dtype": str(self.cache.k.dtype) if self._paged else None,
+            "page_shape": ([int(self.cache.k.shape[0])]
+                           + [int(d) for d in self.cache.k.shape[2:]])
+            if self._paged else [],
+            "page_size": self.page_size if self._paged else None,
+        }
+        contents: Dict[int, Tuple[bytes, bytes]] = {}
+        if not self._paged or self.radix is None:
+            return meta, contents
+        frozen = set(self.radix.registered_pages())
+        valid = [int(p) for p in pages if int(p) in frozen]
+        if not valid:
+            return meta, contents
+        for p in valid:
+            self.allocator.retain(p)
+        try:
+            idx = jnp.asarray(np.asarray(valid, np.int32))
+            k_host = np.asarray(self.cache.k[:, idx])
+            v_host = np.asarray(self.cache.v[:, idx])
+        finally:
+            for p in valid:
+                self.allocator.release(p)
+        for i, p in enumerate(valid):
+            contents[p] = (k_host[:, i].tobytes(), v_host[:, i].tobytes())
+        return meta, contents
+
+    def import_prefix_pages(
+        self,
+        chains: Sequence[Tuple[Sequence[int], Sequence[int]]],
+        contents: Dict[int, Tuple[bytes, bytes]],
+        *,
+        dtype: Optional[str],
+        page_shape: Sequence[int],
+        page_size: Optional[int],
+    ) -> Dict[str, Any]:
+        """Install transferred donor pages into this engine's pool and
+        radix tree. ``chains`` holds donor ``(tokens, donor_pages)``
+        paths; ``contents`` maps donor page id -> ``(k, v)`` bytes —
+        a chain whose page bytes are missing (dropped chunk, snapped
+        stream) keeps its valid PREFIX and sheds the tail, so a partial
+        transfer still warms what arrived intact. Returns ``{"pages":
+        new_radix_pages, "chains": [registered token lists]}``."""
+        result: Dict[str, Any] = {"pages": 0, "chains": []}
+        if not self._paged or self.radix is None:
+            return result
+        expected_shape = tuple(
+            [int(self.cache.k.shape[0])]
+            + [int(d) for d in self.cache.k.shape[2:]])
+        if (page_size != self.page_size
+                or str(dtype) != str(self.cache.k.dtype)
+                or tuple(int(d) for d in page_shape) != expected_shape):
+            logger.warning(
+                "warm import skipped: peer pool is incompatible "
+                "(page_size=%s dtype=%s shape=%s vs local %s/%s/%s)",
+                page_size, dtype, tuple(page_shape),
+                self.page_size, self.cache.k.dtype, expected_shape)
+            return result
+        page_nbytes = int(np.prod(expected_shape)
+                          * np.dtype(self.cache.k.dtype).itemsize)
+        imported: Dict[int, int] = {}       # donor page -> local page
+        newly_allocated: List[int] = []
+        planned: List[Tuple[List[int], List[int]]] = []
+        try:
+            for tokens, donor_pages in chains:
+                local: List[int] = []
+                for dp in donor_pages:
+                    dp = int(dp)
+                    lp = imported.get(dp)
+                    if lp is None:
+                        data = contents.get(dp)
+                        if (data is None or len(data[0]) != page_nbytes
+                                or len(data[1]) != page_nbytes):
+                            break  # chunk never arrived: keep the prefix
+                        got = self.allocator.alloc(1)
+                        if got is None:
+                            break  # pool pressure: warm what fits
+                        lp = got[0]
+                        imported[dp] = lp
+                        newly_allocated.append(lp)
+                    local.append(lp)
+                if local:
+                    planned.append((
+                        [int(t) for t in
+                         tokens[:len(local) * self.page_size]], local))
+            if imported:
+                self._write_imported_pages(imported, contents)
+                created = 0
+                for tokens, local in planned:
+                    created += self.radix.insert(tokens, local)
+                self.metrics.warm_pages_total += created
+                result["pages"] = created
+                result["chains"] = [tokens for tokens, _ in planned]
+        finally:
+            # drop our allocation reference on every imported page:
+            # registered ones fall to the tree's single reference
+            # (frozen-from-birth, evictable at zero slot refs like any
+            # cached prefix); duplicates of chunks the tree already held
+            # — and everything, if the import was interrupted before
+            # insert — free immediately, so the conservation oracle
+            # passes after an aborted transfer
+            for lp in newly_allocated:
+                self.allocator.release(lp)
+            self._update_page_gauges()
+        return result
+
+    def _write_imported_pages(
+        self, imported: Dict[int, int],
+        contents: Dict[int, Tuple[bytes, bytes]],
+    ) -> None:
+        """One masked fill writes every imported page's bytes into the
+        pool — the same audited `fill_slots` compile quarantine rides,
+        fed a cache-shaped value instead of a scalar."""
+        mask = np.zeros(self.num_pages, bool)
+        vk = np.zeros(self.cache.k.shape, self.cache.k.dtype)
+        vv = np.zeros(self.cache.v.shape, self.cache.v.dtype)
+        shape = tuple([vk.shape[0]] + list(vk.shape[2:]))
+        for dp, lp in imported.items():
+            kb, vb = contents[dp]
+            vk[:, lp] = np.frombuffer(kb, vk.dtype).reshape(shape)
+            vv[:, lp] = np.frombuffer(vb, vv.dtype).reshape(shape)
+            mask[lp] = True
+        self.cache = self._fill_slots(
+            self.cache, jnp.asarray(mask),
+            type(self.cache)(jnp.asarray(vk), jnp.asarray(vv)))
 
     def _admit(self) -> None:
         """Move queued requests into free slots and prefill them — ONE

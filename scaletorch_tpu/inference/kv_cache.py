@@ -453,6 +453,39 @@ class RadixPrefixCache:
             node = child
         return created
 
+    def chains(self) -> List[Tuple[List[int], List[int]]]:
+        """Snapshot every root-to-leaf path as ``(tokens, pages)`` —
+        the donor half of warm rejoin. Leaf chains subsume their
+        ancestors (the recipient re-inserts prefixes for free), so the
+        list is the minimal set that reconstructs the tree. Pure read:
+        no LRU touch, no refcount change — the caller decides which
+        pages to retain for how long."""
+        out: List[Tuple[List[int], List[int]]] = []
+        stack: List[Tuple[_RadixNode, List[int], List[int]]] = [
+            (self.root, [], [])]
+        while stack:
+            node, tokens, pages = stack.pop()
+            if not node.children and pages:
+                out.append((tokens, pages))
+                continue
+            for chunk, child in node.children.items():
+                stack.append((child, tokens + list(chunk),
+                              pages + [child.page]))
+        return out
+
+    def registered_pages(self) -> List[int]:
+        """Every page the tree currently holds a reference on (the
+        frozen set a donor may stream; anything else is mutable slot
+        state and must never leave the process)."""
+        pages: List[int] = []
+        stack = [self.root]
+        while stack:
+            node = stack.pop()
+            for child in node.children.values():
+                pages.append(child.page)
+                stack.append(child)
+        return pages
+
     def evict(self, n_pages: int) -> int:
         """Free up to ``n_pages`` pages by pruning LRU leaves whose page
         no live slot references (allocator refcount == 1, the tree's

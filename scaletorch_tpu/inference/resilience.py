@@ -136,7 +136,8 @@ class ServingFaultInjector:
     ``.._SERVE_DEADLINE_STORM_STEP``; gateway:
     ``SCALETORCH_TPU_FT_GW_TENANT_STORM_AT``,
     ``.._GW_TENANT_STORM_COUNT``, ``.._GW_REPLICA_DOWN_AT``,
-    ``.._GW_REPLICA_CRASH_AT``, ``.._GW_REPLICA_HANG_AT``.
+    ``.._GW_REPLICA_CRASH_AT``, ``.._GW_REPLICA_HANG_AT``,
+    ``.._GW_WARM_DONOR_CRASH_AT``, ``.._GW_WARM_CORRUPT_CHUNK_AT``.
     """
 
     nan_logits_at_step: int = 0
@@ -151,6 +152,8 @@ class ServingFaultInjector:
     gw_replica_down_at: int = 0
     gw_replica_crash_at: int = 0
     gw_replica_hang_at: int = 0
+    gw_warm_donor_crash_at: int = 0
+    gw_warm_corrupt_chunk_at: int = 0
     _nan_fired: bool = field(default=False, repr=False)
     _slow_fired: bool = field(default=False, repr=False)
     _storm_fired: bool = field(default=False, repr=False)
@@ -159,6 +162,8 @@ class ServingFaultInjector:
     _gw_down_fired: bool = field(default=False, repr=False)
     _gw_crash_fired: bool = field(default=False, repr=False)
     _gw_hang_fired: bool = field(default=False, repr=False)
+    _gw_warm_crash_fired: bool = field(default=False, repr=False)
+    _gw_warm_corrupt_fired: bool = field(default=False, repr=False)
 
     @classmethod
     def from_config(cls, cfg) -> "ServingFaultInjector":
@@ -204,6 +209,12 @@ class ServingFaultInjector:
             gw_replica_hang_at=int(env_or(
                 "SCALETORCH_TPU_FT_GW_REPLICA_HANG_AT",
                 "ft_gw_replica_hang_at", 0)),
+            gw_warm_donor_crash_at=int(env_or(
+                "SCALETORCH_TPU_FT_GW_WARM_DONOR_CRASH_AT",
+                "ft_gw_warm_donor_crash_at", 0)),
+            gw_warm_corrupt_chunk_at=int(env_or(
+                "SCALETORCH_TPU_FT_GW_WARM_CORRUPT_CHUNK_AT",
+                "ft_gw_warm_corrupt_chunk_at", 0)),
         )
 
     @property
@@ -214,7 +225,9 @@ class ServingFaultInjector:
                     or self.gw_tenant_storm_at
                     or self.gw_replica_down_at
                     or self.gw_replica_crash_at
-                    or self.gw_replica_hang_at)
+                    or self.gw_replica_hang_at
+                    or self.gw_warm_donor_crash_at
+                    or self.gw_warm_corrupt_chunk_at)
 
     def take_nan_logits(self, step: int) -> Optional[int]:
         """Slot index to poison before decode step ``step``, or None."""
@@ -321,6 +334,38 @@ class ServingFaultInjector:
             get_logger().warning(
                 f"gateway fault injection: stalling the routed replica's "
                 f"step loop at dispatch {dispatch}"
+            )
+            return True
+        return False
+
+    def take_gw_warm_donor_crash(self, chunk: int) -> bool:
+        """True when the donor replica must SIGKILL itself after
+        streaming the ``chunk``-th (1-based) warm-transfer frame — the
+        mid-transfer donor death the recipient must survive by falling
+        back to the next peer (or a cold rejoin)."""
+        if self.gw_warm_donor_crash_at \
+                and chunk == self.gw_warm_donor_crash_at \
+                and not self._gw_warm_crash_fired:
+            self._gw_warm_crash_fired = True
+            get_logger().warning(
+                f"gateway fault injection: donor self-SIGKILL after "
+                f"warm-transfer chunk {chunk}"
+            )
+            return True
+        return False
+
+    def take_gw_warm_corrupt_chunk(self, chunk: int) -> bool:
+        """True when the donor must flip bytes in the ``chunk``-th
+        (1-based) warm-transfer frame AFTER checksumming it — the
+        recipient must detect the mismatch, drop that chunk, and keep
+        the rest of the stream."""
+        if self.gw_warm_corrupt_chunk_at \
+                and chunk == self.gw_warm_corrupt_chunk_at \
+                and not self._gw_warm_corrupt_fired:
+            self._gw_warm_corrupt_fired = True
+            get_logger().warning(
+                f"gateway fault injection: corrupting warm-transfer "
+                f"chunk {chunk} in flight"
             )
             return True
         return False

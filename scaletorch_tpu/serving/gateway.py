@@ -90,7 +90,7 @@ from scaletorch_tpu.serving.router import (
 )
 from scaletorch_tpu.serving.slo import LATENCY_OUTCOMES, evaluate_slo
 from scaletorch_tpu.telemetry.export import render_families
-from scaletorch_tpu.telemetry.histogram import TenantHistograms
+from scaletorch_tpu.telemetry.histogram import LogHistogram, TenantHistograms
 from scaletorch_tpu.telemetry.spans import NOOP_SPAN
 from scaletorch_tpu.utils.logger import get_logger
 
@@ -276,8 +276,11 @@ class EngineWorker:
 
     def gauges(self) -> Dict[str, float]:
         """The live EngineMetrics snapshot (flat numeric reads — safe
-        cross-thread)."""
-        return self.engine.metrics.snapshot()
+        cross-thread) plus the compile counter the no-retrace contract
+        watches."""
+        snap = self.engine.metrics.snapshot()
+        snap["decode_compile_count"] = float(self.engine.decode_compile_count)
+        return snap
 
     @property
     def page_size(self) -> int:
@@ -286,6 +289,56 @@ class EngineWorker:
     @property
     def inflight(self) -> int:
         return len(self._handlers)
+
+    # -- warm rejoin (blocking round-trips onto the worker thread) ---------
+    def call_engine(self, fn: Callable[[InferenceEngine], Any],
+                    *, timeout_s: float = 60.0) -> Any:
+        """Run ``fn(engine)`` on the worker thread between ticks and
+        return its result — the synchronous twin of ``submit`` for the
+        warm-rejoin paths, which need a value back rather than a
+        stream. Blocking: call from an executor/request thread, never
+        the event loop."""
+        box: List[Tuple[str, Any]] = []
+        done = threading.Event()
+
+        def _do() -> None:
+            try:
+                box.append(("ok", fn(self.engine)))
+            except Exception as exc:  # delivered to the caller below
+                box.append(("err", exc))
+            finally:
+                done.set()
+
+        self._inbox.put(_do)
+        if not self.alive:
+            self._reap_stale()
+        if not done.wait(timeout_s):
+            raise TimeoutError(
+                f"engine call on replica {self.replica_id} did not "
+                f"return within {timeout_s}s")
+        kind, value = box[0]
+        if kind == "err":
+            raise value
+        return value
+
+    def prefix_map(self) -> Dict[str, Any]:
+        """Donor half: the engine's radix-tree snapshot."""
+        return self.call_engine(lambda e: e.export_prefix_map())
+
+    def export_prefix_pages(self, pages) -> Tuple[Dict[str, Any], Dict]:
+        """Donor half: refcount-retained host copies of frozen pages."""
+        return self.call_engine(lambda e: e.export_prefix_pages(pages))
+
+    def import_prefix_pages(self, chains, contents, *, dtype,
+                            page_shape, page_size) -> Dict[str, Any]:
+        """Recipient half: install transferred pages + register chains
+        (generous timeout: the write is one jitted fill, but the first
+        call may hit its compile)."""
+        return self.call_engine(
+            lambda e: e.import_prefix_pages(
+                chains, contents, dtype=dtype, page_shape=page_shape,
+                page_size=page_size),
+            timeout_s=300.0)
 
     # -- worker-thread internals ------------------------------------------
     def _hook_tokens(self, slot: int, request_id: int,
@@ -617,6 +670,10 @@ class ServingGateway:
         self._open_generates = 0  # generate handlers awaiting a terminal
         self._thread: Optional[threading.Thread] = None
         self._thread_stopped = threading.Event()
+        # warm-rejoin accounting (event-loop only): pages each replica
+        # imported from peers + the transfer-latency distribution
+        self._warm_pages: Dict[str, float] = {}
+        self.warm_hist = LogHistogram()
 
     # -- gauges ------------------------------------------------------------
     def _aggregate_gauges(self) -> Dict[str, float]:
@@ -751,9 +808,12 @@ class ServingGateway:
     def _apply_replica_restart(self, replica_id: str,
                                worker: Any) -> None:
         """Swap the restarted child's fresh worker into the fleet and
-        rejoin it to routing COLD — its radix tree is empty, so the
-        router re-learns its prefixes from scratch (mark_dead dropped
-        the old owner entries when it died)."""
+        rejoin it to routing immediately (its radix tree is empty —
+        mark_dead dropped the old owner entries at death), THEN kick
+        off best-effort warmup as a background task: rejoin/wake happen
+        first, so warming can never delay readiness or block
+        admissions; if it lands, ``_warm_replica`` re-teaches the
+        router the warmed chains."""
         if worker is None:
             return
         old = self.workers.get(replica_id)
@@ -766,6 +826,90 @@ class ServingGateway:
             self.router.rejoin(replica_id)
         if self._wake is not None:
             self._wake.set()
+        if hasattr(worker, "warm_start") and not self._closing:
+            asyncio.ensure_future(self._warm_replica(replica_id, worker))
+
+    # -- warm rejoin orchestration -----------------------------------------
+    def _warm_donor_candidates(
+        self, replica_id: str,
+    ) -> List[Tuple[str, Dict[str, Any]]]:
+        """Rank live peers as warmup donors, healthiest first: free-page
+        headroom (a loaded donor shouldn't also feed a transfer) plus
+        prefix-map size as a fraction of its pool (a donor with no
+        registered pages has nothing to give)."""
+        ranked: List[Tuple[float, str, Dict[str, Any]]] = []
+        for rid, worker in self.workers.items():
+            if rid == replica_id or not worker.alive:
+                continue
+            address = getattr(worker, "address", None)
+            if not address:
+                continue
+            snap = worker.gauges()
+            free = snap.get("page_pool_free", 0.0)
+            used = snap.get("pages_in_use", 0.0)
+            total = free + used
+            headroom = free / total if total else 0.0
+            map_fraction = (snap.get("prefix_pages", 0.0) / total
+                            if total else 0.0)
+            ranked.append((headroom + map_fraction, rid, address))
+        ranked.sort(key=lambda t: (-t[0], t[1]))
+        return [(rid, address) for _score, rid, address in ranked]
+
+    async def _warm_replica(self, replica_id: str, worker: Any) -> None:
+        """Best-effort warmup of a restarted replica from its peers.
+        Runs as a detached task AFTER the replica rejoined routing; the
+        blocking pull rides an executor thread, so neither readiness
+        nor admissions wait on it. Every failure mode ends in the cold
+        rejoin the fleet already survives."""
+        donors = self._warm_donor_candidates(replica_id)
+        if not donors:
+            self._emit_warmup(replica_id, status="cold", donor=None,
+                              pages=0, seconds=0.0,
+                              detail="no live peers")
+            return
+        started = time.monotonic()
+        loop = asyncio.get_running_loop()
+        payload = [address for _rid, address in donors]
+        try:
+            summary = await loop.run_in_executor(
+                None, worker.warm_start, payload)
+        except Exception:
+            logger.exception("warmup of replica %s raised", replica_id)
+            summary = None
+        elapsed = time.monotonic() - started
+        if worker is not self.workers.get(replica_id):
+            return  # replaced again mid-warm: stale result, drop it
+        if not summary:
+            self._emit_warmup(replica_id, status="cold", donor=None,
+                              pages=0, seconds=round(elapsed, 4),
+                              detail="warm_start unreachable")
+            return
+        pages = int(summary.get("pages", 0) or 0)
+        if pages > 0:
+            self._warm_pages[replica_id] = \
+                self._warm_pages.get(replica_id, 0.0) + pages
+            self.warm_hist.observe(elapsed)
+            for tokens in summary.get("chains", []):
+                self.router.learn_owner(tokens, replica_id)
+            if self._wake is not None:
+                self._wake.set()
+        self._emit_warmup(
+            replica_id, status=str(summary.get("status", "cold")),
+            donor=summary.get("donor"), pages=pages,
+            seconds=round(elapsed, 4),
+            chunks_dropped=summary.get("chunks_dropped", 0),
+            attempts=summary.get("attempts", 0))
+
+    def _emit_warmup(self, replica_id: str, **record: Any) -> None:
+        logger.info("warm rejoin: replica %s %s (%s pages, donor %s)",
+                    replica_id, record.get("status"),
+                    record.get("pages"), record.get("donor"))
+        if self.exporter is not None:
+            try:
+                self.exporter.emit("warmup",
+                                   {"replica": replica_id, **record})
+            except Exception:
+                logger.exception("warmup telemetry export failed")
 
     async def stop(self, *, drain: bool = True,
                    timeout_s: float = 60.0) -> None:
@@ -1266,6 +1410,17 @@ class ServingGateway:
                      1.0 if s.get("state") == "up" else 0.0)
                     for rid, s in sorted(status.items())],
             })
+        families.append({
+            "name": "replica_warm_pages_total", "type": "counter",
+            "samples": [
+                ({"replica": rid}, float(self._warm_pages.get(rid, 0.0)))
+                for rid in sorted(self.workers)],
+        })
+        if self.warm_hist.count:
+            families.append({
+                "name": "warm_transfer_seconds", "type": "histogram",
+                "series": [(None, self.warm_hist)],
+            })
         engine_samples: Dict[str, List] = {}
         for rid, worker in self.workers.items():
             for key, value in worker.gauges().items():
@@ -1326,6 +1481,8 @@ class ServingGateway:
                 "slot_occupancy": snap.get("slot_occupancy"),
                 "pages_in_use": snap.get("pages_in_use"),
                 "page_pool_free": snap.get("page_pool_free"),
+                "prefix_pages": snap.get("prefix_pages"),
+                "warm_pages": snap.get("warm_pages_total"),
             }
             # process state: from the supervisor when one runs the
             # fleet, else whatever the worker itself knows (a remote

@@ -31,9 +31,11 @@ always ends with exactly one ``done``.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import re
 import secrets
+import struct
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -315,3 +317,107 @@ def stream_tokens(events: List[Tuple[str, Dict[str, Any]]]) -> List[int]:
         if name == "token":
             out.extend(payload["token_ids"])
     return out
+
+
+# --------------------------------------------------------------------------
+# Warm-transfer framing (POST /warm response stream)
+# --------------------------------------------------------------------------
+#
+# The warm-rejoin path streams frozen KV pages donor -> recipient as a
+# sequence of length-prefixed binary frames over one HTTP response body
+# (chunked transfer is overkill: the connection closes at end-of-stream
+# anyway, and a snapped socket is a first-class failure mode the frames
+# must survive). Frame layout:
+#
+#     !4s  magic     b"STWM"
+#     !I   index     0 = JSON meta frame; 1..N = page frames (index i
+#                    carries the i-th entry of the request's ``pages``
+#                    list, so a resume at ``start_chunk`` re-aligns by
+#                    position); 0xFFFFFFFF = clean end-of-stream marker
+#                    (its absence means the donor died mid-transfer)
+#     !I   payload_len
+#     !32s sha256(payload)  per-chunk checksum: a mismatch drops THIS
+#                    chunk only, the rest of the stream stays usable
+#
+# Page-frame payload: ``!III page_id len_k len_v`` + k_bytes + v_bytes.
+# A page the donor no longer holds frozen ships as a zero-content frame
+# (lengths 0) so indices stay aligned for resume.
+
+WARM_MAGIC = b"STWM"
+WARM_END_INDEX = 0xFFFFFFFF
+WARM_HEADER = struct.Struct("!4sII32s")
+WARM_PAGE_HEADER = struct.Struct("!III")
+# a page frame is bounded by pool geometry; 256 MiB is far beyond any
+# real page and cheap insurance against a garbage length field
+MAX_WARM_PAYLOAD = 256 * 2**20
+
+
+def encode_warm_frame(index: int, payload: bytes) -> bytes:
+    """One warm-transfer frame: header (magic, index, length, sha256)
+    followed by the payload bytes."""
+    digest = hashlib.sha256(payload).digest()
+    return WARM_HEADER.pack(WARM_MAGIC, index, len(payload),
+                            digest) + payload
+
+
+def corrupt_warm_frame(frame: bytes) -> bytes:
+    """The ``--ft_gw_warm_corrupt_chunk_at`` drill: flip the last
+    payload byte AFTER checksumming, so the recipient's per-chunk
+    verification must catch it. Frames with an empty payload corrupt
+    the checksum itself instead."""
+    out = bytearray(frame)
+    out[-1] ^= 0xFF
+    return bytes(out)
+
+
+def encode_warm_page_payload(page_id: int, k_bytes: bytes,
+                             v_bytes: bytes) -> bytes:
+    """Page-frame payload: id + both cache halves (k then v)."""
+    return WARM_PAGE_HEADER.pack(
+        page_id, len(k_bytes), len(v_bytes)) + k_bytes + v_bytes
+
+
+def decode_warm_page_payload(
+        payload: bytes) -> Tuple[int, bytes, bytes]:
+    """Inverse of ``encode_warm_page_payload``; raises ProtocolError on
+    a malformed payload (lengths not adding up)."""
+    if len(payload) < WARM_PAGE_HEADER.size:
+        raise ProtocolError("warm page payload too short")
+    page_id, len_k, len_v = WARM_PAGE_HEADER.unpack_from(payload)
+    if WARM_PAGE_HEADER.size + len_k + len_v != len(payload):
+        raise ProtocolError(
+            f"warm page payload length mismatch for page {page_id}")
+    k = payload[WARM_PAGE_HEADER.size:WARM_PAGE_HEADER.size + len_k]
+    v = payload[WARM_PAGE_HEADER.size + len_k:]
+    return page_id, k, v
+
+
+def read_warm_frame(fp: Any) -> Optional[Tuple[int, bytes, bool]]:
+    """Read exactly one frame off a blocking file-like (``resp.read``
+    semantics: may return short on EOF). Returns ``(index, payload,
+    checksum_ok)``, or ``None`` on EOF / a truncated or garbled header
+    — the caller treats that as a snapped stream and resumes from the
+    last good chunk."""
+    header = _read_exact(fp, WARM_HEADER.size)
+    if header is None:
+        return None
+    magic, index, length, digest = WARM_HEADER.unpack(header)
+    if magic != WARM_MAGIC or length > MAX_WARM_PAYLOAD:
+        return None
+    payload = _read_exact(fp, length) if length else b""
+    if payload is None:
+        return None
+    ok = hashlib.sha256(payload).digest() == digest
+    return index, payload, ok
+
+
+def _read_exact(fp: Any, n: int) -> Optional[bytes]:
+    chunks = []
+    remaining = n
+    while remaining > 0:
+        chunk = fp.read(remaining)
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)

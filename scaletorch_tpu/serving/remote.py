@@ -43,9 +43,30 @@ Wire schema (all JSON bodies carry ``v: 1``; the SSE framing is
                          contract's "clean drain" — no restart).
   ``POST /v1/hang``      {"seconds"} — drill: stall the worker's step
                          loop so the serving watchdog fires exit 44.
-  ``GET  /healthz``      pid, liveness, page_size, inflight.
+  ``GET  /healthz``      pid, liveness, page_size, inflight, warm/prefix
+                         page gauges.
   ``GET  /metrics``      the live ``EngineMetrics`` snapshot (flat
                          gauges) + pid + ``decode_compile_count``.
+  ``GET  /prefix_map``   warm-rejoin donor half: the radix-tree
+                         snapshot (token chains, page ids, page-aligned
+                         chunk hashes, per-page refcount/frozen state).
+  ``POST /warm``         warm-rejoin donor half: stream the requested
+                         FROZEN pages' K/V bytes as length-prefixed
+                         checksummed frames (``protocol.WARM_HEADER``);
+                         resumable via ``start_chunk``. The donor
+                         serves from refcount-retained host snapshots —
+                         its pool and its conservation never move.
+  ``POST /v1/warm_start`` warm-rejoin recipient half: given a ranked
+                         donor list, pull ``/prefix_map`` + ``/warm``
+                         from the first donor that answers (retry with
+                         backoff, then the next peer, then cold),
+                         import the pages, and answer with a summary.
+                         Runs on an executor thread CONCURRENTLY with
+                         serving — a warming replica keeps admitting.
+
+The wire is transport-agnostic: ``ReplicaServer(uds=...)`` listens on a
+unix domain socket instead of TCP (``--serve_replica_uds``), and
+``RemoteEngineWorker(uds=...)`` connects to one — same schema, no port.
 
 Failure semantics: a replica killed ``-9`` mid-stream closes every
 submit socket; each reader thread synthesizes exactly one ``aborted``
@@ -66,6 +87,8 @@ import asyncio
 import http.client
 import json
 import os
+import signal
+import socket
 import threading
 import time
 from typing import (
@@ -80,6 +103,7 @@ from typing import (
 
 from scaletorch_tpu.serving import protocol
 from scaletorch_tpu.serving.protocol import GenerateRequest, ProtocolError
+from scaletorch_tpu.serving.router import page_chunk_hashes
 from scaletorch_tpu.utils.logger import get_logger
 
 logger = get_logger(__name__)
@@ -117,11 +141,17 @@ class ReplicaServer:
     """
 
     def __init__(self, worker: Any, *, host: str = "127.0.0.1",
-                 port: int = 0) -> None:
+                 port: int = 0, uds: Optional[str] = None,
+                 injector: Any = None) -> None:
         self.worker = worker
         self._host = host
         self._requested_port = port
         self.port: Optional[int] = None
+        self.uds = uds
+        # warm-transfer fault drills (donor side): duck-typed to
+        # ``ServingFaultInjector.take_gw_warm_donor_crash`` /
+        # ``take_gw_warm_corrupt_chunk`` — None means no drills armed
+        self.injector = injector
         self._server: Optional[asyncio.AbstractServer] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._drain_event: Optional[asyncio.Event] = None
@@ -134,11 +164,19 @@ class ReplicaServer:
     async def start(self) -> "ReplicaServer":
         self._loop = asyncio.get_running_loop()
         self._drain_event = asyncio.Event()
-        self._server = await asyncio.start_server(
-            self._handle_connection, self._host, self._requested_port)
-        self.port = self._server.sockets[0].getsockname()[1]
-        logger.info("replica server on http://%s:%d (pid %d)",
-                    self._host, self.port, os.getpid())
+        if self.uds:
+            if os.path.exists(self.uds):
+                os.unlink(self.uds)  # a stale socket from a kill -9'd life
+            self._server = await asyncio.start_unix_server(
+                self._handle_connection, path=self.uds)
+            logger.info("replica server on uds %s (pid %d)",
+                        self.uds, os.getpid())
+        else:
+            self._server = await asyncio.start_server(
+                self._handle_connection, self._host, self._requested_port)
+            self.port = self._server.sockets[0].getsockname()[1]
+            logger.info("replica server on http://%s:%d (pid %d)",
+                        self._host, self.port, os.getpid())
         return self
 
     async def wait_drain(self) -> None:
@@ -225,6 +263,12 @@ class ReplicaServer:
                 await self._respond_json(writer, 200, self.health_payload())
             elif route == "/metrics" and method == "GET":
                 await self._respond_json(writer, 200, self.metrics_payload())
+            elif route == "/prefix_map" and method == "GET":
+                await self._handle_prefix_map(writer)
+            elif route == "/warm" and method == "POST":
+                await self._handle_warm(writer, body)
+            elif route == "/v1/warm_start" and method == "POST":
+                await self._handle_warm_start(writer, body)
             else:
                 await self._respond_json(
                     writer, 404, {"detail": f"no route {method} {path!r}"})
@@ -253,6 +297,10 @@ class ReplicaServer:
 
     # -- endpoint payloads -------------------------------------------------
     def health_payload(self) -> Dict[str, Any]:
+        try:
+            gauges = self.worker.gauges()
+        except Exception:
+            gauges = {}
         return {
             "v": protocol.PROTOCOL_VERSION,
             "pid": os.getpid(),
@@ -260,6 +308,8 @@ class ReplicaServer:
             "draining": self.draining,
             "page_size": getattr(self.worker, "page_size", None),
             "inflight": self.worker.inflight,
+            "warm_pages": gauges.get("warm_pages_total", 0),
+            "prefix_pages": gauges.get("prefix_pages", 0),
         }
 
     def metrics_payload(self) -> Dict[str, Any]:
@@ -301,6 +351,111 @@ class ReplicaServer:
                        "(the serving watchdog should fire exit 44)",
                        seconds)
         self.worker.stall(seconds)
+
+    # -- warm rejoin endpoints ---------------------------------------------
+    async def _handle_prefix_map(self,
+                                 writer: asyncio.StreamWriter) -> None:
+        """Donor: snapshot the radix tree. The engine read runs on an
+        executor thread (it round-trips through the worker inbox, a
+        blocking wait the event loop must not make)."""
+        fn = getattr(self.worker, "prefix_map", None)
+        if fn is None:
+            await self._respond_json(writer, 200, {
+                "v": protocol.PROTOCOL_VERSION, "page_size": None,
+                "chains": [], "pages": {}})
+            return
+        loop = asyncio.get_running_loop()
+        payload = await loop.run_in_executor(None, fn)
+        payload["v"] = protocol.PROTOCOL_VERSION
+        page_size = payload.get("page_size")
+        if page_size:
+            for chain in payload.get("chains", []):
+                chain["hashes"] = page_chunk_hashes(
+                    chain["tokens"], page_size,
+                    max_chunks=len(chain["pages"]))
+        await self._respond_json(writer, 200, payload)
+
+    async def _handle_warm(self, writer: asyncio.StreamWriter,
+                           body: bytes) -> None:
+        """Donor: stream the requested frozen pages as checksummed
+        frames. Frame 0 carries the pool meta (dtype/shape); page
+        frames are 1-based over the REQUEST's page order so a resume at
+        ``start_chunk`` re-aligns by position; a terminal
+        ``WARM_END_INDEX`` frame marks clean completion (its absence
+        means this donor died mid-transfer)."""
+        try:
+            obj = json.loads(body.decode("utf-8")) if body.strip() else {}
+            pages = [int(p) for p in obj.get("pages", [])]
+            start_chunk = max(1, int(obj.get("start_chunk", 1)))
+        except (ValueError, TypeError, UnicodeDecodeError):
+            raise ProtocolError(
+                "warm body must carry integer 'pages'") from None
+        exporter = getattr(self.worker, "export_prefix_pages", None)
+        if exporter is None:
+            raise ProtocolError("replica has no paged prefix state",
+                                status=404)
+        loop = asyncio.get_running_loop()
+        meta, contents = await loop.run_in_executor(None, exporter, pages)
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: application/octet-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        meta_payload = dict(meta)
+        meta_payload["v"] = protocol.PROTOCOL_VERSION
+        meta_payload["pages"] = pages
+        writer.write(protocol.encode_warm_frame(
+            0, json.dumps(meta_payload).encode("utf-8")))
+        await writer.drain()
+        injector = self.injector
+        for i, page in enumerate(pages):
+            index = i + 1
+            if index < start_chunk:
+                continue  # the recipient already holds this chunk
+            k_bytes, v_bytes = contents.get(page, (b"", b""))
+            frame = protocol.encode_warm_frame(
+                index,
+                protocol.encode_warm_page_payload(page, k_bytes, v_bytes))
+            if injector is not None \
+                    and injector.take_gw_warm_corrupt_chunk(index):
+                frame = protocol.corrupt_warm_frame(frame)
+            writer.write(frame)
+            await writer.drain()
+            if injector is not None \
+                    and injector.take_gw_warm_donor_crash(index):
+                # the drill IS the donor dying mid-transfer: no flush,
+                # no goodbye — the recipient sees a snapped stream
+                os.kill(os.getpid(), signal.SIGKILL)
+        writer.write(protocol.encode_warm_frame(
+            protocol.WARM_END_INDEX, b""))
+        await writer.drain()
+
+    async def _handle_warm_start(self, writer: asyncio.StreamWriter,
+                                 body: bytes) -> None:
+        """Recipient: pull prefix state from the given donors (ranked
+        best-first by the gateway) and import it. Blocks THIS request
+        only — the pull runs on an executor thread, the event loop
+        keeps serving submits, so warming never delays readiness or
+        admissions."""
+        try:
+            obj = json.loads(body.decode("utf-8")) if body.strip() else {}
+            donors = list(obj.get("donors", []))
+            backoff_s = float(obj.get("backoff_s", 0.2))
+            attempts = int(obj.get("attempts_per_donor", 2))
+        except (ValueError, TypeError, UnicodeDecodeError):
+            raise ProtocolError("warm_start body must be JSON") from None
+        if getattr(self.worker, "import_prefix_pages", None) is None:
+            await self._respond_json(writer, 200, {
+                "v": protocol.PROTOCOL_VERSION, "status": "unsupported",
+                "pages": 0, "chains": []})
+            return
+        loop = asyncio.get_running_loop()
+        summary = await loop.run_in_executor(
+            None,
+            lambda: pull_warm_state(
+                self.worker, donors, attempts_per_donor=attempts,
+                backoff_s=backoff_s))
+        summary["v"] = protocol.PROTOCOL_VERSION
+        await self._respond_json(writer, 200, summary)
 
     async def _handle_submit(self, reader: asyncio.StreamReader,
                              writer: asyncio.StreamWriter,
@@ -412,6 +567,186 @@ def _done_payload(req: GenerateRequest, result: Any) -> Dict[str, Any]:
 
 
 # --------------------------------------------------------------------------
+# Warm-transfer client (recipient side)
+# --------------------------------------------------------------------------
+
+
+class _UDSHTTPConnection(http.client.HTTPConnection):
+    """``http.client`` over a unix domain socket — the v:1 wire is
+    transport-agnostic; only ``connect()`` differs."""
+
+    def __init__(self, path: str,
+                 timeout: Optional[float] = None) -> None:
+        super().__init__("localhost", timeout=timeout)
+        self.uds_path = path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        if self.timeout is not None:
+            sock.settimeout(self.timeout)
+        sock.connect(self.uds_path)
+        self.sock = sock
+
+
+def _donor_connection(donor: Dict[str, Any],
+                      timeout: float) -> http.client.HTTPConnection:
+    if donor.get("uds"):
+        return _UDSHTTPConnection(str(donor["uds"]), timeout=timeout)
+    return http.client.HTTPConnection(
+        str(donor.get("host", "127.0.0.1")), int(donor["port"]),
+        timeout=timeout)
+
+
+def _donor_label(donor: Dict[str, Any]) -> str:
+    if donor.get("replica"):
+        return str(donor["replica"])
+    if donor.get("uds"):
+        return str(donor["uds"])
+    return f"{donor.get('host', '127.0.0.1')}:{donor.get('port')}"
+
+
+def _transfer_pages(
+    donor: Dict[str, Any], page_order: List[int], start_chunk: int,
+    contents: Dict[int, Tuple[bytes, bytes]], *, timeout: float,
+) -> Tuple[int, int, bool]:
+    """One ``POST /warm`` round: read frames into ``contents`` until
+    the terminal frame or the stream snaps. Returns ``(chunks_dropped,
+    next_start_chunk, completed)`` — a checksum mismatch drops that
+    chunk and keeps reading (the stream framing is still sound); a
+    truncated/garbled stream stops and reports where to resume."""
+    dropped = 0
+    next_start = start_chunk
+    conn = _donor_connection(donor, timeout)
+    try:
+        conn.request(
+            "POST", "/warm",
+            body=json.dumps({"v": protocol.PROTOCOL_VERSION,
+                             "pages": page_order,
+                             "start_chunk": start_chunk}).encode(),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        if resp.status != 200:
+            return dropped, next_start, False
+        while True:
+            frame = protocol.read_warm_frame(resp)
+            if frame is None:
+                return dropped, next_start, False  # snapped mid-stream
+            index, payload, checksum_ok = frame
+            if index == protocol.WARM_END_INDEX:
+                return dropped, next_start, True
+            if index == 0:
+                continue  # meta frame: the caller already has the map
+            if not checksum_ok:
+                dropped += 1           # drop THIS chunk, keep the rest
+                next_start = index + 1
+                continue
+            try:
+                page_id, k_bytes, v_bytes = \
+                    protocol.decode_warm_page_payload(payload)
+            except ProtocolError:
+                dropped += 1
+                next_start = index + 1
+                continue
+            if k_bytes or v_bytes:
+                contents[page_id] = (k_bytes, v_bytes)
+            next_start = index + 1
+    finally:
+        conn.close()
+
+
+def pull_warm_state(
+    worker: Any, donors: List[Dict[str, Any]], *,
+    attempts_per_donor: int = 2, backoff_s: float = 0.2,
+    connect_timeout_s: float = 10.0,
+) -> Dict[str, Any]:
+    """Warm this replica's prefix cache from the first donor that
+    delivers (the recipient half of warm rejoin; blocking — run on an
+    executor thread). Strictly best-effort, degrading exactly as the
+    fleet does: a donor that dies mid-transfer is retried with backoff
+    (resuming from the last good chunk), then the next peer; corrupt
+    chunks are dropped individually; with no live peers — or nothing to
+    give — the replica serves cold, today's behavior."""
+    started = time.monotonic()
+    summary: Dict[str, Any] = {
+        "status": "cold", "donor": None, "pages": 0, "chains": [],
+        "chunks_dropped": 0, "attempts": 0, "elapsed_s": 0.0,
+    }
+    for donor in donors:
+        label = _donor_label(donor)
+        pmap: Optional[Dict[str, Any]] = None
+        for attempt in range(attempts_per_donor):
+            summary["attempts"] += 1
+            try:
+                conn = _donor_connection(donor, connect_timeout_s)
+                try:
+                    conn.request("GET", "/prefix_map")
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status != 200:
+                        raise http.client.HTTPException(
+                            f"/prefix_map -> {resp.status}")
+                    pmap = json.loads(body.decode("utf-8"))
+                finally:
+                    conn.close()
+                break
+            except (OSError, http.client.HTTPException, ValueError):
+                time.sleep(backoff_s * (2 ** attempt))
+        if pmap is None:
+            logger.warning("warm pull: donor %s unreachable, trying "
+                           "the next peer", label)
+            continue
+        chains = pmap.get("chains") or []
+        if not chains:
+            continue  # a live donor with an empty map: nothing to give
+        page_order: List[int] = []
+        seen = set()
+        for chain in chains:
+            for page in chain.get("pages", []):
+                if page not in seen:
+                    seen.add(page)
+                    page_order.append(int(page))
+        contents: Dict[int, Tuple[bytes, bytes]] = {}
+        dropped = 0
+        start_chunk = 1
+        completed = False
+        for attempt in range(attempts_per_donor):
+            try:
+                delta, start_chunk, completed = _transfer_pages(
+                    donor, page_order, start_chunk, contents,
+                    timeout=connect_timeout_s)
+                dropped += delta
+            except (OSError, http.client.HTTPException):
+                pass
+            if completed:
+                break
+            time.sleep(backoff_s * (2 ** attempt))
+        summary["chunks_dropped"] += dropped
+        if not contents and not completed:
+            logger.warning("warm pull: donor %s died mid-transfer with "
+                           "nothing delivered, trying the next peer",
+                           label)
+            continue
+        try:
+            result = worker.import_prefix_pages(
+                [(c["tokens"], c["pages"]) for c in chains], contents,
+                dtype=pmap.get("dtype"),
+                page_shape=pmap.get("page_shape", []),
+                page_size=pmap.get("page_size"))
+        except Exception:
+            logger.exception("warm pull: import from donor %s failed; "
+                             "trying the next peer", label)
+            continue
+        if result.get("pages", 0) > 0 or completed:
+            summary.update(
+                status="warmed" if completed else "partial",
+                donor=label, pages=result.get("pages", 0),
+                chains=result.get("chains", []))
+            break
+    summary["elapsed_s"] = round(time.monotonic() - started, 4)
+    return summary
+
+
+# --------------------------------------------------------------------------
 # Gateway-process half: the remote worker
 # --------------------------------------------------------------------------
 
@@ -455,6 +790,7 @@ class RemoteEngineWorker:
 
     def __init__(self, host: str, port: int, *, replica_id: str,
                  proc: Any = None,
+                 uds: Optional[str] = None,
                  poll_interval_s: float = 0.1,
                  connect_timeout_s: float = 10.0,
                  ready_timeout_s: float = 60.0,
@@ -468,6 +804,7 @@ class RemoteEngineWorker:
         self.tick_listeners: List[Callable[[], None]] = []
         self._host = host
         self._port = port
+        self._uds = uds
         self.poll_interval_s = poll_interval_s
         self.connect_timeout_s = connect_timeout_s
         self.ready_timeout_s = ready_timeout_s
@@ -504,12 +841,49 @@ class RemoteEngineWorker:
                 last = exc
                 time.sleep(0.05)
         else:
+            where = self._uds or f"{self._host}:{self._port}"
             raise TimeoutError(
-                f"replica {self.replica_id} at {self._host}:{self._port} "
+                f"replica {self.replica_id} at {where} "
                 f"never answered /healthz: {last}")
         self.alive = True
         self._poller.start()
         return self
+
+    @property
+    def address(self) -> Dict[str, Any]:
+        """Where a PEER reaches this replica (the donor entry the
+        gateway hands a warming recipient)."""
+        if self._uds:
+            return {"uds": self._uds, "replica": self.replica_id}
+        return {"host": self._host, "port": self._port,
+                "replica": self.replica_id}
+
+    def warm_start(self, donors: List[Dict[str, Any]], *,
+                   backoff_s: float = 0.2,
+                   timeout_s: float = 300.0) -> Optional[Dict[str, Any]]:
+        """Ask the replica to warm itself from ``donors`` (ranked
+        best-first). Blocking until the replica's pull finishes (run
+        from an executor); returns the summary payload, or None when
+        the replica is unreachable / the warm path is unsupported."""
+        try:
+            conn = self._connection(timeout=timeout_s)
+            try:
+                conn.request(
+                    "POST", "/v1/warm_start",
+                    body=json.dumps({
+                        "v": protocol.PROTOCOL_VERSION,
+                        "donors": donors,
+                        "backoff_s": backoff_s}).encode(),
+                    headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                body = resp.read()
+                if resp.status != 200:
+                    return None
+                return json.loads(body.decode("utf-8"))
+            finally:
+                conn.close()
+        except (OSError, http.client.HTTPException, ValueError):
+            return None
 
     def shutdown(self, *, drain: bool = True) -> None:
         """Ask the replica to drain and exit 0. Non-blocking (the
@@ -590,9 +964,14 @@ class RemoteEngineWorker:
             return len(self._inflight)
 
     # -- internals ---------------------------------------------------------
-    def _connection(self) -> http.client.HTTPConnection:
-        return http.client.HTTPConnection(
-            self._host, self._port, timeout=self.connect_timeout_s)
+    def _connection(
+        self, timeout: Optional[float] = None,
+    ) -> http.client.HTTPConnection:
+        t = self.connect_timeout_s if timeout is None else timeout
+        if self._uds:
+            return _UDSHTTPConnection(self._uds, timeout=t)
+        return http.client.HTTPConnection(self._host, self._port,
+                                          timeout=t)
 
     def _get_json(self, path: str) -> Dict[str, Any]:
         conn = self._connection()

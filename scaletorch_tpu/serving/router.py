@@ -187,18 +187,39 @@ class PrefixAwareRouter:
             del self._owners[k]
 
     def rejoin(self, replica_id: str) -> None:
-        """Return a restarted replica to rotation COLD: its process is
-        new, its radix tree empty, so it re-enters with no owner-map
-        entries (``mark_dead``/``report_exit`` dropped them at death)
-        and the router re-learns its prefixes from live traffic."""
+        """Return a restarted replica to rotation. It re-enters with no
+        owner-map entries (``mark_dead``/``report_exit`` dropped them
+        at death) — cold, unless the gateway's best-effort warmup
+        lands, in which case ``learn_owner`` re-teaches the warmed
+        chains and affinity resumes without waiting on live traffic."""
         st = self.replicas[replica_id]
         if st.healthy:
             return
         st.healthy = True
         st.exit_code = None
         st.rejoins += 1
-        logger.info("router: replica %s rejoined rotation cold "
+        logger.info("router: replica %s rejoined rotation "
                     "(%d alive)", replica_id, len(self.alive()))
+
+    def learn_owner(self, prompt: Sequence[int], replica_id: str) -> None:
+        """Teach the owner map that ``replica_id`` holds this prompt's
+        page-aligned prefix — the warm-rejoin path: a restarted replica
+        that imported a donor's chains owns them NOW, so post-restart
+        affinity resumes immediately instead of re-learning from (and
+        cold-prefilling) live traffic. Overwrites any previous owner:
+        the warmed replica is the freshest holder and the deepest-owner
+        rule keeps routing correct for longer chains."""
+        if not self.prefix_aware:
+            return
+        st = self.replicas.get(replica_id)
+        if st is None or not st.healthy:
+            return
+        for h in page_chunk_hashes(prompt, self.page_size,
+                                   max_chunks=self.max_chunks):
+            self._owners[h] = replica_id
+            self._owners.move_to_end(h)
+        while len(self._owners) > self._max_tracked:
+            self._owners.popitem(last=False)
 
     def report_exit(self, replica_id: str, exit_code: int) -> None:
         """Apply the 0/42/43/44 exit-code contract: 0 is a clean drain

@@ -57,6 +57,7 @@ from scaletorch_tpu.utils.logger import get_logger
 logger = get_logger(__name__)
 
 READY_PREFIX = "READY port="
+READY_UDS_PREFIX = "READY uds="
 
 # Replica lifecycle states surfaced on /healthz.
 STATES = ("starting", "up", "backoff", "drained", "failed", "stopped")
@@ -74,7 +75,8 @@ class _Replica:
         self.replica_id = replica_id
         self.state = "starting"
         self.proc: Any = None
-        self.port: Optional[int] = None
+        # TCP port (int) or UDS socket path (str) from the READY line
+        self.port: Optional[Any] = None
         self.pid: Optional[int] = None
         self.last_exit_code: Optional[int] = None
         self.restarts_total = 0
@@ -267,13 +269,14 @@ class ReplicaSupervisor:
             logger.exception("supervisor telemetry export failed")
 
     # -- spawn / ready -----------------------------------------------------
-    def _wait_ready(self, proc: Any) -> Optional[int]:
+    def _wait_ready(self, proc: Any) -> Optional[Any]:
         """Read the child's stdout until ``READY port=<n>`` (returns the
-        port) or EOF/timeout/death (returns None). The remaining stdout
-        is pumped by a daemon thread so a chatty child never blocks on
-        a full pipe."""
+        port, an int) or ``READY uds=<path>`` (returns the socket path,
+        a str — the UDS transport's address), or EOF/timeout/death
+        (returns None). The remaining stdout is pumped by a daemon
+        thread so a chatty child never blocks on a full pipe."""
         deadline = time.monotonic() + self.ready_timeout_s
-        port: Optional[int] = None
+        port: Optional[Any] = None
         stdout = getattr(proc, "stdout", None)
         if stdout is None:
             return None
@@ -300,6 +303,14 @@ class ReplicaSupervisor:
                 try:
                     port = int(line[len(READY_PREFIX):].split()[0])
                 except (ValueError, IndexError):
+                    return None
+                break
+            if line.startswith(READY_UDS_PREFIX):
+                try:
+                    port = line[len(READY_UDS_PREFIX):].split()[0]
+                except IndexError:
+                    return None
+                if not port:
                     return None
                 break
         if port is None:

@@ -48,10 +48,12 @@ SCHEMA_VERSION = 1
 # status, trace_id, queue_wait/ttft/e2e, tokens, prefix_hit, replica)
 # and its ``latency_histograms`` records (TenantHistograms.to_record —
 # sparse per-tenant bucket state, mergeable offline by slo_check).
-# Free-form kinds are allowed; these are the ones consumers can rely
-# on. Adding a kind is additive — v stays 1.
+# ``warmup`` records one peer-to-peer warm-rejoin attempt per restart
+# (replica, status warmed/partial/cold, donor, pages, seconds,
+# chunks_dropped, attempts). Free-form kinds are allowed; these are the
+# ones consumers can rely on. Adding a kind is additive — v stays 1.
 KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics",
-               "access", "latency_histograms", "supervisor")
+               "access", "latency_histograms", "supervisor", "warmup")
 
 
 class TelemetryExporter:

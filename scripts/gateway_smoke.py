@@ -39,9 +39,17 @@ exactly one terminal (``aborted``), the supervisor must restart the
 child (new pid on ``/healthz``, ``replica_restarts_total`` bumped), a
 follow-up request must stream bit-identical tokens to the direct
 engine, the /metrics ledger must balance THROUGH the crash
-(``http_requests_received == sum(outcomes)``), SIGTERM must drain the
-whole fleet to exit 0, and the supervisor's JSONL event stream
-(spawn/ready/crash/restart) plus slo_check must hold on the artifacts.
+(``http_requests_received == sum(outcomes)``). Then the WARM-REJOIN
+drill: the healed request left prefix pages on one replica (the
+donor), so a second kill -9 of the OTHER replica must come back
+WARMED — the supervisor restarts it, the gateway pulls the donor's
+frozen prefix pages peer-to-peer concurrent with readiness,
+``/healthz`` reports the transferred pages, and the FIRST post-restart
+shared-prefix request records a prefix HIT with bit-identical tokens
+and zero retraces (``engine_decode_compile_count == 1`` fleet-wide).
+SIGTERM must drain the whole fleet to exit 0, and the supervisor's
+JSONL event stream (spawn/ready/crash/restart) plus the ``warmup``
+record plus slo_check must hold on the artifacts.
 """
 
 from __future__ import annotations
@@ -313,31 +321,119 @@ def main_mp(procs: int) -> int:
         ups = [v for k, v in prom.items()
                if k.startswith("scaletorch_replica_up")]
         assert len(ups) == procs and all(u == 1.0 for u in ups), prom
-        prom_path = os.path.join(TELEMETRY_DIR, "metrics_scrape.txt")
-        with open(prom_path, "w") as f:
-            f.write(metrics)
         print("[smoke-mp] conservation through the crash OK "
               f"(received={received:g} == outcomes={outcome_sum:g}; "
               f"restarts={sum(restarts):g})")
 
-        # 5. SIGTERM drains the WHOLE fleet to exit 0
+        # 5. warm rejoin: request 2 left prefix pages on ONE replica
+        #    (the donor); kill -9 the OTHER — the supervisor restarts
+        #    it and the gateway warms it peer-to-peer, concurrent with
+        #    readiness, so /healthz must show the transferred pages
+        deadline = time.monotonic() + 120
+        donor = None
+        while time.monotonic() < deadline:
+            health = json.loads(urllib.request.urlopen(
+                f"{base}/healthz", timeout=30).read())
+            donors = [rid for rid, rep in health["replicas"].items()
+                      if (rep.get("prefix_pages") or 0) > 0]
+            if donors:
+                donor = donors[0]
+                break
+            time.sleep(0.25)
+        assert donor is not None, f"no replica registered prefix " \
+            f"pages after request 2: {health}"
+        victim2 = next(rid for rid in sorted(health["replicas"])
+                       if rid != donor)
+        rep2 = health["replicas"][victim2]
+        restarts_before = rep2["restarts_total"]
+        os.kill(rep2["pid"], signal.SIGKILL)
+        deadline = time.monotonic() + 300
+        warmed = None
+        while time.monotonic() < deadline:
+            health = json.loads(urllib.request.urlopen(
+                f"{base}/healthz", timeout=30).read())
+            rep = health["replicas"][victim2]
+            if rep.get("state") == "up" \
+                    and rep.get("restarts_total", 0) > restarts_before \
+                    and (rep.get("warm_pages") or 0) > 0:
+                warmed = rep
+                break
+            time.sleep(0.5)
+        assert warmed is not None, (
+            f"restarted {victim2} never reported warmed pages: {health}")
+        print(f"[smoke-mp] warm rejoin OK: {victim2} restarted with "
+              f"{warmed['warm_pages']:g} pages pulled from {donor}")
+
+        # 6. FIRST post-restart shared-prefix request: the router's
+        #    learned ownership sends it to the warmed replica, which
+        #    serves a prefix HIT with bit-identical tokens
+        _, streamed, dones, _ = stream_generate(base)
+        assert len(dones) == 1 and dones[0]["outcome"] == "ok", dones
+        assert streamed == reference, (
+            f"warmed-replica stream diverged:\n"
+            f"  streamed:  {streamed}\n  reference: {reference}")
+        print("[smoke-mp] warmed-replica SSE bit-parity OK over "
+              f"{len(streamed)} tokens")
+
+        # 7. the ledger balances THROUGH the warm cycle, the warm
+        #    metric families are live, and neither engine retraced
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30).read().decode()
+        prom = parse_prom(metrics)
+        received = prom["scaletorch_http_requests_received"]
+        assert received == 3.0, received
+        assert prom["scaletorch_http_aborted"] == 1.0, prom
+        assert prom["scaletorch_http_ok"] == 2.0, prom
+        warm_key = (f'scaletorch_replica_warm_pages_total'
+                    f'{{replica="{victim2}"}}')
+        assert prom.get(warm_key, 0.0) >= 1.0, (warm_key, prom)
+        assert "scaletorch_warm_transfer_seconds" in metrics, \
+            metrics[:400]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            compiles = [
+                v for k, v in parse_prom(urllib.request.urlopen(
+                    f"{base}/metrics", timeout=30).read().decode()
+                ).items()
+                if k.startswith("scaletorch_engine_decode_compile_count")]
+            if len(compiles) == procs and all(c == 1.0 for c in compiles):
+                break
+            time.sleep(0.5)
+        assert len(compiles) == procs and all(c == 1.0 for c in compiles), (
+            f"warming must not retrace: decode compile counts {compiles}")
+        prom_path = os.path.join(TELEMETRY_DIR, "metrics_scrape.txt")
+        with open(prom_path, "w") as f:
+            f.write(metrics)
+        print("[smoke-mp] conservation + one-compile through the warm "
+              f"cycle OK (received={received:g})")
+
+        # 8. SIGTERM drains the WHOLE fleet to exit 0
         proc.send_signal(signal.SIGTERM)
         rc = proc.wait(timeout=180)
         assert rc == 0, f"drain exit code {rc}, want 0"
         print("[smoke-mp] SIGTERM fleet drain exit 0 OK")
 
-        # 6. post-mortem: supervisor JSONL events + access + slo gates
+        # 9. post-mortem: supervisor JSONL events + warmup + access +
+        #    slo gates
         events_path = os.path.join(TELEMETRY_DIR, "gateway_events.jsonl")
         records = [json.loads(line) for line in open(events_path)]
         sup_events = [r["event"] for r in records
                       if r.get("kind") == "supervisor"]
         for needed in ("spawn", "ready", "crash", "restart"):
             assert needed in sup_events, (needed, sup_events)
+        warmups = [r for r in records if r.get("kind") == "warmup"]
+        assert any(r["replica"] == victim2 and r["status"] == "warmed"
+                   and r["pages"] >= 1 and r["donor"] == donor
+                   for r in warmups), warmups
         access = [r for r in records if r.get("kind") == "access"]
-        assert len(access) == 2, access
+        assert len(access) == 3, access
         assert sorted(r["outcome"] for r in access) == \
-            ["aborted", "ok"], access
-        print(f"[smoke-mp] supervisor event stream OK ({sup_events})")
+            ["aborted", "ok", "ok"], access
+        # the warmed replica's FIRST request hit the transferred prefix
+        assert any(r["outcome"] == "ok" and r["replica"] == victim2
+                   and r["prefix_hit"] is True for r in access), access
+        print(f"[smoke-mp] supervisor + warmup event streams OK "
+              f"({sup_events})")
         run_slo_check(events_path, prom_path)
         return 0
     finally:

@@ -7,8 +7,8 @@ the v:1 replica wire (serving/remote.py) — its OWN process, its own
 GIL, its own compile cache, its own failure domain. The parent
 (``scripts/serve.py --serve_replica_procs N`` via
 ``serving.supervisor.ReplicaSupervisor``) spawns it, reads ``READY
-port=<n>`` from stdout, and talks to it through a
-``RemoteEngineWorker``.
+port=<n>`` (or ``READY uds=<path>`` with ``--uds``) from stdout, and
+talks to it through a ``RemoteEngineWorker``.
 
 Exit-code contract (docs/fault_tolerance.md):
 
@@ -58,20 +58,39 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--port", type=int, default=0,
                    help="0 = ephemeral; the bound port rides the "
                         "READY line.")
+    p.add_argument("--uds", default="",
+                   help="Bind a unix-domain socket at this path instead "
+                        "of TCP; READY then reads 'READY uds=<path>'.")
     p.add_argument("--watchdog_timeout_s", type=float, default=120.0,
                    help="Serving stall watchdog (exit 44); <= 0 "
                         "disarms it.")
     p.add_argument("--crash_report_dir", default="results")
     p.add_argument("--drain_timeout_s", type=float, default=30.0)
+    # warm-transfer drills (donor side, fired by ReplicaServer while
+    # streaming /warm; env SCALETORCH_TPU_FT_GW_WARM_* wins when present)
+    p.add_argument("--ft_gw_warm_donor_crash_at", type=int, default=0,
+                   help="SIGKILL this process after streaming the k-th "
+                        "warm chunk.")
+    p.add_argument("--ft_gw_warm_corrupt_chunk_at", type=int, default=0,
+                   help="Flip bytes in the k-th warm chunk after "
+                        "checksumming.")
     return p.parse_args(argv)
 
 
 async def _serve(args, worker) -> None:
+    from scaletorch_tpu.inference.resilience import ServingFaultInjector
     from scaletorch_tpu.serving.remote import ReplicaServer
 
-    server = ReplicaServer(worker, host=args.host, port=args.port)
+    injector = ServingFaultInjector.from_config(args)
+    server = ReplicaServer(
+        worker, host=args.host, port=args.port,
+        uds=args.uds or None,
+        injector=injector if injector.active else None)
     await server.start()
-    print(f"READY port={server.port}", flush=True)
+    if args.uds:
+        print(f"READY uds={args.uds}", flush=True)
+    else:
+        print(f"READY port={server.port}", flush=True)
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, server.request_drain)

@@ -105,6 +105,18 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--ft_gw_replica_hang_at", type=int, default=0,
                    help="Stall the replica serving the k-th dispatch "
                         "so its watchdog exits 44.")
+    p.add_argument("--ft_gw_warm_donor_crash_at", type=int, default=0,
+                   help="SIGKILL the warm-transfer donor after it "
+                        "streams the k-th /warm chunk (process mode).")
+    p.add_argument("--ft_gw_warm_corrupt_chunk_at", type=int, default=0,
+                   help="Flip bytes in the k-th /warm chunk after "
+                        "checksumming — the recipient must drop it and "
+                        "keep the rest.")
+    p.add_argument("--serve_replica_uds", default="",
+                   help="Directory for per-replica unix-domain sockets: "
+                        "process-mode replicas bind <dir>/<rid>.sock "
+                        "instead of a TCP port (the warm-transfer wire "
+                        "and dispatch both ride the socket).")
     return p.parse_args(argv)
 
 
@@ -173,6 +185,15 @@ def make_replica_spawner(args):
                str(args.replica_watchdog_timeout_s)]
         if args.model_name_or_path:
             cmd += ["--model_name_or_path", args.model_name_or_path]
+        if args.serve_replica_uds:
+            cmd += ["--uds", os.path.join(args.serve_replica_uds,
+                                          f"{replica_id}.sock")]
+        if args.ft_gw_warm_donor_crash_at:
+            cmd += ["--ft_gw_warm_donor_crash_at",
+                    str(args.ft_gw_warm_donor_crash_at)]
+        if args.ft_gw_warm_corrupt_chunk_at:
+            cmd += ["--ft_gw_warm_corrupt_chunk_at",
+                    str(args.ft_gw_warm_corrupt_chunk_at)]
         return subprocess.Popen(cmd, stdout=subprocess.PIPE, text=True)
 
     return spawn
@@ -185,7 +206,15 @@ def build_replica_fleet(args, exporter=None):
     from scaletorch_tpu.serving.remote import RemoteEngineWorker
     from scaletorch_tpu.serving.supervisor import ReplicaSupervisor
 
-    def worker_factory(replica_id: str, port: int, proc):
+    if args.serve_replica_uds:
+        os.makedirs(args.serve_replica_uds, exist_ok=True)
+
+    def worker_factory(replica_id: str, port, proc):
+        # READY gave either a TCP port (int) or a UDS path (str)
+        if isinstance(port, str):
+            return RemoteEngineWorker(
+                "127.0.0.1", 0, replica_id=replica_id, proc=proc,
+                uds=port).start()
         return RemoteEngineWorker(
             "127.0.0.1", port, replica_id=replica_id, proc=proc).start()
 

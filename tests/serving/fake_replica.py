@@ -62,6 +62,13 @@ class FakeEngineWorker:
         self._next_id = 0
         self._live = set()
         self._cancelled = {}
+        # warm-rejoin double state: (tokens, pages) chains plus
+        # per-page byte contents, same duck surface the real
+        # EngineWorker bridges to the engine
+        self.warm_pages_total = 0
+        self._chains = []
+        self._page_contents = {}
+        self._next_page = 0
 
     # -- observability ------------------------------------------------------
     @property
@@ -72,11 +79,16 @@ class FakeEngineWorker:
     def gauges(self):
         with self._lock:
             live = len(self._live)
+            prefix_pages = len(self._page_contents)
+            warm = self.warm_pages_total
         return {
             "queue_depth": 0.0,
             "slot_occupancy": live / 4.0,
             "pages_in_use": float(live),
             "page_pool_free": float(self.page_pool - live),
+            "prefix_pages": float(prefix_pages),
+            "warm_pages_total": float(warm),
+            "decode_compile_count": 1.0,
         }
 
     # -- control ------------------------------------------------------------
@@ -100,6 +112,92 @@ class FakeEngineWorker:
     def expected_tokens(self, prompt, n):
         base = sum(prompt) % self.vocab
         return [(base + i) % self.vocab for i in range(n)]
+
+    # -- warm-rejoin surface (prefix_map / export / import) -----------------
+    @staticmethod
+    def page_bytes(page: int, nbytes: int):
+        """Deterministic (k, v) contents for a page id — any observer
+        can recompute them, so transfer tests assert bit-parity."""
+        k = bytes((page * 31 + i) % 256 for i in range(nbytes))
+        v = bytes((page * 37 + i + 1) % 256 for i in range(nbytes))
+        return k, v
+
+    def seed_prefix(self, tokens) -> int:
+        """Register a frozen prefix chain (complete pages only) with
+        deterministic contents; returns the number of pages seeded."""
+        n = len(tokens) // self.page_size
+        if n == 0:
+            return 0
+        with self._lock:
+            pages = list(range(self._next_page, self._next_page + n))
+            self._next_page += n
+            for p in pages:
+                self._page_contents[p] = self.page_bytes(p, self.page_size)
+            self._chains.append((list(tokens[:n * self.page_size]), pages))
+        return n
+
+    def prefix_map(self):
+        with self._lock:
+            chains = [{"tokens": list(t), "pages": list(p)}
+                      for t, p in self._chains]
+            pages = {p: {"refcount": 1, "frozen": True}
+                     for p in self._page_contents}
+            used = len(self._page_contents)
+        return {
+            "page_size": self.page_size,
+            "dtype": "uint8",
+            "page_shape": [1, 1, self.page_size, 1],
+            "chains": chains,
+            "pages": pages,
+            "capacity": self.page_pool,
+            "free": self.page_pool - used,
+        }
+
+    def export_prefix_pages(self, pages):
+        meta = {"dtype": "uint8",
+                "page_shape": [1, 1, self.page_size, 1],
+                "page_size": self.page_size}
+        with self._lock:
+            contents = {int(p): self._page_contents[int(p)]
+                        for p in pages if int(p) in self._page_contents}
+        return meta, contents
+
+    def import_prefix_pages(self, chains, contents, *, dtype,
+                            page_shape, page_size) -> dict:
+        if page_size != self.page_size or dtype != "uint8":
+            return {"pages": 0, "chains": []}
+        created, kept = 0, []
+        with self._lock:
+            mapped = {}
+            for tokens, pages in chains:
+                valid = 0
+                for p in pages:
+                    if int(p) in mapped or int(p) in contents:
+                        valid += 1
+                    else:
+                        break
+                if valid == 0:
+                    continue
+                local = []
+                for p in pages[:valid]:
+                    p = int(p)
+                    if p not in mapped:
+                        mapped[p] = self._next_page
+                        self._next_page += 1
+                        self._page_contents[mapped[p]] = contents[p]
+                        created += 1
+                    local.append(mapped[p])
+                tokens = list(tokens[:valid * self.page_size])
+                self._chains.append((tokens, local))
+                kept.append(tokens)
+            self.warm_pages_total += created
+        return {"pages": created, "chains": kept}
+
+    def _has_warm_prefix(self, prompt) -> bool:
+        with self._lock:
+            return any(len(t) <= len(prompt)
+                       and list(prompt[:len(t)]) == t
+                       for t, _ in self._chains if t)
 
     # -- the request path ---------------------------------------------------
     def submit(self, req, on_tokens, on_done, *, ttl_s=None,
@@ -139,7 +237,8 @@ class FakeEngineWorker:
             request_id=rid, prompt=list(req.prompt), tokens=tokens,
             finish_reason=reason, outcome=outcome, detail=detail,
             ttft_s=None, latency_s=None, queue_wait_s=0.0,
-            prefill_s=0.0, prefix_hit=False, trace_id=req.trace_id))
+            prefill_s=0.0, prefix_hit=self._has_warm_prefix(req.prompt),
+            trace_id=req.trace_id))
 
 
 def parse_args(argv=None) -> argparse.Namespace:
@@ -151,6 +250,16 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--drain_timeout_s", type=float, default=10.0)
     p.add_argument("--selfcrash_after_s", type=float, default=0.0)
     p.add_argument("--selfcrash_code", type=int, default=42)
+    p.add_argument("--uds", default="",
+                   help="Bind a unix-domain socket instead of TCP; "
+                        "READY then prints 'READY uds=<path>'.")
+    p.add_argument("--warm_chain", default="",
+                   help="Comma-separated tokens to seed as a frozen "
+                        "prefix chain (complete pages only) so this "
+                        "fake can DONATE warm state.")
+    p.add_argument("--page_size", type=int, default=4)
+    p.add_argument("--ft_gw_warm_donor_crash_at", type=int, default=0)
+    p.add_argument("--ft_gw_warm_corrupt_chunk_at", type=int, default=0)
     return p.parse_args(argv)
 
 
@@ -158,11 +267,19 @@ async def _serve(args, worker) -> None:
     import asyncio
     import signal
 
+    from scaletorch_tpu.inference.resilience import ServingFaultInjector
     from scaletorch_tpu.serving.remote import ReplicaServer
 
-    server = ReplicaServer(worker, host=args.host, port=args.port)
+    injector = ServingFaultInjector.from_config(args)
+    server = ReplicaServer(
+        worker, host=args.host, port=args.port,
+        uds=args.uds or None,
+        injector=injector if injector.active else None)
     await server.start()
-    print(f"READY port={server.port}", flush=True)
+    if args.uds:
+        print(f"READY uds={args.uds}", flush=True)
+    else:
+        print(f"READY port={server.port}", flush=True)
     if args.selfcrash_after_s > 0:
         # armed AFTER READY so the crash clock never races the boot
         timer = threading.Timer(
@@ -185,7 +302,11 @@ def main(argv=None) -> int:
     import asyncio
 
     args = parse_args(argv)
-    worker = FakeEngineWorker(token_delay_s=args.token_delay_s)
+    worker = FakeEngineWorker(token_delay_s=args.token_delay_s,
+                              page_size=args.page_size)
+    if args.warm_chain:
+        worker.seed_prefix(
+            [int(t) for t in args.warm_chain.split(",") if t.strip()])
     asyncio.run(_serve(args, worker))
     return 0
 

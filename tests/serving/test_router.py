@@ -93,6 +93,30 @@ class TestRouterMembership:
         moved = [k for k in keys if before[k] != after[k]]
         assert all(before[k] == "b" for k in moved)
 
+    def test_learn_owner_teaches_warmed_prefixes(self):
+        """Warm rejoin: the gateway re-teaches ownership of chains a
+        restarted replica pulled from a peer, so shared-prefix traffic
+        routes back to it without a cold re-learn."""
+        router = PrefixAwareRouter(["r0", "r1", "r2"], PAGE)
+        chain = [5] * 8
+        router.learn_owner(chain, "r1")
+        for tail in ([1], [2, 3], []):
+            assert router.route(chain + tail) == "r1"
+
+    def test_learn_owner_ignores_dead_and_unknown_replicas(self):
+        router = PrefixAwareRouter(["r0", "r1"], PAGE)
+        chain = [5] * 8
+        router.mark_dead("r1", exit_code=44)
+        router.learn_owner(chain, "r1")      # dead: refused
+        router.learn_owner(chain, "ghost")   # unknown: refused
+        assert router.route(chain) == "r0"
+
+    def test_learn_owner_noop_when_prefix_unaware(self):
+        router = PrefixAwareRouter(["r0", "r1"], PAGE,
+                                   prefix_aware=False)
+        router.learn_owner([5] * 8, "r1")
+        assert router.snapshot()["router_tracked_prefixes"] == 0.0
+
     def test_owner_map_is_lru_bounded(self):
         router = PrefixAwareRouter(["r0", "r1"], PAGE,
                                    max_tracked_prefixes=8)

@@ -363,6 +363,8 @@ class TestGatewayConservationUnderCrashes:
         def killer():
             while not stop_killing.is_set():
                 time.sleep(rng.uniform(0.15, 0.4))
+                if stop_killing.is_set():
+                    break  # no straggler kill after the clients finish
                 with sup._lock:
                     up = [r for r in sup._replicas.values()
                           if r.state == "up" and r.proc is not None
@@ -412,10 +414,16 @@ class TestGatewayConservationUnderCrashes:
             assert kills, "the schedule never actually killed a child"
             # the ledger balances THROUGH the crashes
             gw.metrics.check_conservation()
-            # the fleet healed: kills were restarted
-            wait_for(lambda: all(
-                st["state"] == "up" for st in sup.status().values()),
-                timeout=30, msg="fleet healed")
+            # the fleet healed: kills were restarted. Require a LIVE
+            # process, not just state "up" — a corpse the monitor has
+            # not reaped yet still reads "up" for a poll interval.
+            def healed():
+                with sup._lock:
+                    return all(r.state == "up" and r.proc is not None
+                               and r.proc.poll() is None
+                               for r in sup._replicas.values())
+
+            wait_for(healed, timeout=30, msg="fleet healed")
             total_restarts = sum(st["restarts_total"]
                                  for st in sup.status().values())
             assert total_restarts >= 1

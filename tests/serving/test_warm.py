@@ -1,0 +1,675 @@
+"""Warm rejoin: the peer-to-peer prefix transfer wire and its drills.
+
+Three rings, inside out: (1) the framing alone — checksummed
+length-prefixed frames round-trip, corruption is detected, truncation
+reads as a snapped stream; (2) the transfer wire in-process — a
+``ReplicaServer`` donor over the jax-free ``FakeEngineWorker`` streams
+``/prefix_map`` + ``/warm`` to ``pull_warm_state``, including the
+corrupt-chunk drill (drop that chunk, keep the rest) and resume; (3)
+real child processes — a donor SIGKILL'd mid-transfer degrades to the
+next peer then cold, the UDS transport carries both dispatch and warm
+traffic, and a supervised gateway fleet under a randomized kill -9
+schedule warms restarted replicas while conserving every HTTP request.
+"""
+
+import http.client
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from scaletorch_tpu.inference.resilience import ServingFaultInjector
+from scaletorch_tpu.serving import protocol
+from scaletorch_tpu.serving.protocol import ProtocolError
+from scaletorch_tpu.serving.remote import (
+    RemoteEngineWorker,
+    ReplicaServer,
+    _transfer_pages,
+    pull_warm_state,
+)
+
+from .fake_replica import FakeEngineWorker
+
+TESTS_DIR = os.path.dirname(os.path.abspath(__file__))
+FAKE_REPLICA = os.path.join(TESTS_DIR, "fake_replica.py")
+CHAIN = [1, 2, 3, 5, 8, 13, 21, 34]  # two full pages at page_size=4
+
+
+def child_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(TESTS_DIR)) + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def spawn_child(*extra_args):
+    """fake_replica.py child; returns (proc, port_or_uds_path)."""
+    proc = subprocess.Popen(
+        [sys.executable, FAKE_REPLICA, *extra_args],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=child_env())
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"fake replica died before READY rc={proc.poll()}")
+        if line.startswith("READY port="):
+            return proc, int(line.strip().split("=", 1)[1])
+        if line.startswith("READY uds="):
+            return proc, line.strip().split("=", 1)[1]
+    raise RuntimeError("fake replica never printed READY")
+
+
+class ServerThread:
+    """An in-process ReplicaServer on its own event-loop thread."""
+
+    def __init__(self, worker, *, uds=None, injector=None):
+        self.worker = worker
+        self.uds = uds
+        self.injector = injector
+        self.server = None
+        self.port = None
+        self._loop = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="warm-server-test", daemon=True)
+
+    def _run(self):
+        import asyncio
+
+        async def main():
+            self._loop = asyncio.get_running_loop()
+            self.server = ReplicaServer(
+                self.worker, port=0, uds=self.uds,
+                injector=self.injector)
+            await self.server.start()
+            self.port = self.server.port
+            self._started.set()
+            await self.server.wait_drain()
+            await self.server.close()
+
+        asyncio.run(main())
+
+    def start(self):
+        self._thread.start()
+        assert self._started.wait(10), "replica server never bound"
+        return self
+
+    def stop(self):
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self.server.request_drain)
+        self._thread.join(10)
+
+
+def get_json(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, json.loads(resp.read().decode())
+    finally:
+        conn.close()
+
+
+def wait_for(predicate, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+class TestWarmFrames:
+    """Ring 1: the framing alone, no sockets."""
+
+    def test_frame_roundtrip(self, tmp_path):
+        payload = protocol.encode_warm_page_payload(7, b"kkkk", b"vvvv")
+        frame = protocol.encode_warm_frame(3, payload)
+        path = tmp_path / "frames.bin"
+        path.write_bytes(frame + protocol.encode_warm_frame(
+            protocol.WARM_END_INDEX, b""))
+        with open(path, "rb") as fp:
+            index, got, ok = protocol.read_warm_frame(fp)
+            assert (index, ok) == (3, True)
+            assert protocol.decode_warm_page_payload(got) == \
+                (7, b"kkkk", b"vvvv")
+            index, got, ok = protocol.read_warm_frame(fp)
+            assert index == protocol.WARM_END_INDEX and ok
+            assert protocol.read_warm_frame(fp) is None  # EOF
+
+    def test_corruption_is_detected_not_raised(self, tmp_path):
+        frame = protocol.corrupt_warm_frame(
+            protocol.encode_warm_frame(1, b"payload-bytes"))
+        path = tmp_path / "bad.bin"
+        path.write_bytes(frame)
+        with open(path, "rb") as fp:
+            index, _payload, ok = protocol.read_warm_frame(fp)
+        assert index == 1 and ok is False
+
+    def test_truncated_stream_reads_as_snapped(self, tmp_path):
+        frame = protocol.encode_warm_frame(2, b"x" * 64)
+        path = tmp_path / "cut.bin"
+        path.write_bytes(frame[: len(frame) - 10])
+        with open(path, "rb") as fp:
+            assert protocol.read_warm_frame(fp) is None
+
+    def test_page_payload_length_mismatch_raises(self):
+        payload = protocol.encode_warm_page_payload(1, b"abc", b"de")
+        with pytest.raises(ProtocolError):
+            protocol.decode_warm_page_payload(payload[:-1])
+
+
+class TestDonorWire:
+    """Ring 2: donor endpoints + the pull client, in-process."""
+
+    def test_prefix_map_endpoint(self):
+        worker = FakeEngineWorker(page_size=4)
+        assert worker.seed_prefix(CHAIN + [55]) == 2  # partial page shed
+        srv = ServerThread(worker).start()
+        try:
+            status, pmap = get_json(srv.port, "/prefix_map")
+            assert status == 200
+            assert pmap["page_size"] == 4
+            assert pmap["dtype"] == "uint8"
+            chain = pmap["chains"][0]
+            assert chain["tokens"] == CHAIN
+            assert chain["pages"] == [0, 1]
+            # page-aligned cumulative hashes ride the map for the router
+            assert len(chain["hashes"]) == 2
+            assert pmap["pages"]["0"]["frozen"] is True
+        finally:
+            srv.stop()
+
+    def test_prefix_map_without_surface_is_empty(self):
+        worker = FakeEngineWorker(page_size=4)
+        worker.prefix_map = None  # a replica with no paged prefix state
+        srv = ServerThread(worker).start()
+        try:
+            status, pmap = get_json(srv.port, "/prefix_map")
+            assert status == 200
+            assert pmap["chains"] == [] and pmap["pages"] == {}
+        finally:
+            srv.stop()
+
+    def test_warm_stream_is_bit_identical(self):
+        worker = FakeEngineWorker(page_size=4)
+        worker.seed_prefix(CHAIN)
+        srv = ServerThread(worker).start()
+        try:
+            contents = {}
+            dropped, _next, completed = _transfer_pages(
+                {"host": "127.0.0.1", "port": srv.port}, [0, 1], 1,
+                contents, timeout=10)
+            assert (dropped, completed) == (0, True)
+            assert contents == {0: worker.page_bytes(0, 4),
+                                1: worker.page_bytes(1, 4)}
+        finally:
+            srv.stop()
+
+    def test_resume_skips_delivered_chunks(self):
+        worker = FakeEngineWorker(page_size=4)
+        worker.seed_prefix(CHAIN)
+        srv = ServerThread(worker).start()
+        try:
+            contents = {}
+            _d, _n, completed = _transfer_pages(
+                {"host": "127.0.0.1", "port": srv.port}, [0, 1], 2,
+                contents, timeout=10)
+            assert completed
+            assert list(contents) == [1]  # chunk 1 was never re-sent
+        finally:
+            srv.stop()
+
+    def test_corrupt_chunk_dropped_rest_kept(self):
+        worker = FakeEngineWorker(page_size=4)
+        worker.seed_prefix(CHAIN)
+        srv = ServerThread(
+            worker,
+            injector=ServingFaultInjector(gw_warm_corrupt_chunk_at=1),
+        ).start()
+        try:
+            contents = {}
+            dropped, _n, completed = _transfer_pages(
+                {"host": "127.0.0.1", "port": srv.port}, [0, 1], 1,
+                contents, timeout=10)
+            assert (dropped, completed) == (1, True)
+            assert list(contents) == [1]  # chunk 2 survived the drill
+        finally:
+            srv.stop()
+
+
+class TestPullWarmState:
+    """Ring 2 continued: the full pull, recipient import, degradation."""
+
+    def test_pull_warms_recipient(self):
+        donor = FakeEngineWorker(page_size=4)
+        donor.seed_prefix(CHAIN)
+        srv = ServerThread(donor).start()
+        recipient = FakeEngineWorker(page_size=4)
+        try:
+            summary = pull_warm_state(
+                recipient,
+                [{"host": "127.0.0.1", "port": srv.port,
+                  "replica": "rd"}],
+                backoff_s=0.01)
+            assert summary["status"] == "warmed"
+            assert summary["donor"] == "rd"
+            assert summary["pages"] == 2
+            assert summary["chains"] == [CHAIN]
+            assert summary["chunks_dropped"] == 0
+            assert recipient.gauges()["warm_pages_total"] == 2.0
+            # bit parity: the recipient now holds the donor's bytes
+            _meta, got = recipient.export_prefix_pages([0, 1])
+            assert got == {0: donor.page_bytes(0, 4),
+                           1: donor.page_bytes(1, 4)}
+            assert recipient._has_warm_prefix(CHAIN + [99])
+        finally:
+            srv.stop()
+
+    def test_no_peers_is_cold(self):
+        recipient = FakeEngineWorker(page_size=4)
+        summary = pull_warm_state(recipient, [], backoff_s=0.01)
+        assert summary["status"] == "cold"
+        assert summary["attempts"] == 0
+        assert recipient.gauges()["warm_pages_total"] == 0.0
+
+    def test_unreachable_donor_is_cold(self):
+        recipient = FakeEngineWorker(page_size=4)
+        summary = pull_warm_state(
+            recipient, [{"host": "127.0.0.1", "port": 1}],
+            attempts_per_donor=2, backoff_s=0.01)
+        assert summary["status"] == "cold"
+        assert summary["attempts"] == 2  # retried with backoff first
+
+    def test_empty_donor_falls_through_to_next_peer(self):
+        cold_donor = FakeEngineWorker(page_size=4)  # nothing to give
+        warm_donor = FakeEngineWorker(page_size=4)
+        warm_donor.seed_prefix(CHAIN)
+        s1 = ServerThread(cold_donor).start()
+        s2 = ServerThread(warm_donor).start()
+        recipient = FakeEngineWorker(page_size=4)
+        try:
+            summary = pull_warm_state(
+                recipient,
+                [{"host": "127.0.0.1", "port": s1.port, "replica": "a"},
+                 {"host": "127.0.0.1", "port": s2.port, "replica": "b"}],
+                backoff_s=0.01)
+            assert summary["status"] == "warmed"
+            assert summary["donor"] == "b"
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_corrupt_tail_imports_valid_prefix(self):
+        donor = FakeEngineWorker(page_size=4)
+        donor.seed_prefix(CHAIN)
+        srv = ServerThread(
+            donor,
+            injector=ServingFaultInjector(gw_warm_corrupt_chunk_at=2),
+        ).start()
+        recipient = FakeEngineWorker(page_size=4)
+        try:
+            summary = pull_warm_state(
+                recipient,
+                [{"host": "127.0.0.1", "port": srv.port}],
+                backoff_s=0.01)
+            # the stream completed (drop chunk, keep the rest), so the
+            # import keeps the chain's valid one-page prefix
+            assert summary["status"] == "warmed"
+            assert summary["chunks_dropped"] == 1
+            assert summary["pages"] == 1
+            assert summary["chains"] == [CHAIN[:4]]
+        finally:
+            srv.stop()
+
+    def test_incompatible_pool_imports_nothing(self):
+        donor = FakeEngineWorker(page_size=4)
+        donor.seed_prefix(CHAIN)
+        srv = ServerThread(donor).start()
+        recipient = FakeEngineWorker(page_size=8)  # pool mismatch
+        try:
+            summary = pull_warm_state(
+                recipient,
+                [{"host": "127.0.0.1", "port": srv.port}],
+                backoff_s=0.01)
+            assert summary["pages"] == 0
+            assert recipient.gauges()["warm_pages_total"] == 0.0
+        finally:
+            srv.stop()
+
+
+class TestWarmChildren:
+    """Ring 3: real child processes — donor death, UDS, warm_start."""
+
+    def test_donor_crash_falls_back_to_next_peer(self):
+        chain_arg = ",".join(str(t) for t in CHAIN)
+        # the flaky donor corrupts chunk 1 AND dies right after it, so
+        # it delivers nothing useful before the stream snaps
+        flaky, flaky_port = spawn_child(
+            "--replica_id", "flaky", "--warm_chain", chain_arg,
+            "--ft_gw_warm_corrupt_chunk_at", "1",
+            "--ft_gw_warm_donor_crash_at", "1")
+        steady, steady_port = spawn_child(
+            "--replica_id", "steady", "--warm_chain", chain_arg)
+        recipient = FakeEngineWorker(page_size=4)
+        try:
+            summary = pull_warm_state(
+                recipient,
+                [{"host": "127.0.0.1", "port": flaky_port,
+                  "replica": "flaky"},
+                 {"host": "127.0.0.1", "port": steady_port,
+                  "replica": "steady"}],
+                attempts_per_donor=2, backoff_s=0.01)
+            assert summary["status"] == "warmed"
+            assert summary["donor"] == "steady"
+            assert summary["pages"] == 2
+            assert recipient._has_warm_prefix(CHAIN)
+            wait_for(lambda: flaky.poll() is not None,
+                     msg="flaky donor died")
+            assert flaky.poll() == -9  # the drill IS a SIGKILL
+        finally:
+            for proc in (flaky, steady):
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_crashing_only_donor_degrades_to_cold(self):
+        chain_arg = ",".join(str(t) for t in CHAIN)
+        flaky, flaky_port = spawn_child(
+            "--replica_id", "flaky", "--warm_chain", chain_arg,
+            "--ft_gw_warm_corrupt_chunk_at", "1",
+            "--ft_gw_warm_donor_crash_at", "1")
+        recipient = FakeEngineWorker(page_size=4)
+        try:
+            summary = pull_warm_state(
+                recipient,
+                [{"host": "127.0.0.1", "port": flaky_port,
+                  "replica": "flaky"}],
+                attempts_per_donor=2, backoff_s=0.01)
+            assert summary["status"] == "cold"
+            assert summary["pages"] == 0
+            assert recipient.gauges()["warm_pages_total"] == 0.0
+        finally:
+            flaky.kill()
+            flaky.wait(timeout=10)
+
+    def test_uds_transport_serves_and_warms(self, tmp_path):
+        chain_arg = ",".join(str(t) for t in CHAIN)
+        sock = str(tmp_path / "donor.sock")
+        proc, path = spawn_child(
+            "--replica_id", "uds0", "--uds", sock,
+            "--warm_chain", chain_arg, "--token_delay_s", "0.0")
+        assert path == sock
+        remote = RemoteEngineWorker(
+            "127.0.0.1", 0, replica_id="uds0", uds=sock).start()
+        recipient = FakeEngineWorker(page_size=4)
+        try:
+            assert remote.alive
+            assert remote.address == {"uds": sock, "replica": "uds0"}
+            # dispatch rides the socket
+            from .test_remote import make_req, run_request
+
+            out = run_request(remote, make_req([3, 1, 4], 6))
+            oracle = FakeEngineWorker()
+            assert out["result"].outcome == "ok"
+            assert out["tokens"] == oracle.expected_tokens([3, 1, 4], 6)
+            # ...and so does the warm transfer
+            summary = pull_warm_state(
+                recipient, [{"uds": sock, "replica": "uds0"}],
+                backoff_s=0.01)
+            assert summary["status"] == "warmed"
+            assert summary["pages"] == 2
+        finally:
+            remote.stop_polling()
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_warm_start_endpoint_pulls_and_reports(self):
+        chain_arg = ",".join(str(t) for t in CHAIN)
+        donor, donor_port = spawn_child(
+            "--replica_id", "donor", "--warm_chain", chain_arg)
+        cold, cold_port = spawn_child("--replica_id", "cold")
+        remote = RemoteEngineWorker(
+            "127.0.0.1", cold_port, replica_id="cold").start()
+        try:
+            summary = remote.warm_start(
+                [{"host": "127.0.0.1", "port": donor_port,
+                  "replica": "donor"}], backoff_s=0.01)
+            assert summary["status"] == "warmed"
+            assert summary["pages"] == 2
+            # the warmed state is visible on the replica's health surface
+            _status, health = get_json(cold_port, "/healthz")
+            assert health["warm_pages"] == 2
+            assert health["prefix_pages"] == 2
+        finally:
+            remote.stop_polling()
+            for proc in (donor, cold):
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+class RecordingExporter:
+    def __init__(self):
+        self.records = []
+        self._lock = threading.Lock()
+
+    def emit(self, kind, record):
+        with self._lock:
+            self.records.append((kind, dict(record)))
+
+    def of_kind(self, kind):
+        with self._lock:
+            return [r for k, r in self.records if k == kind]
+
+
+class TestWarmGatewayFleet:
+    """Ring 3 continued: the supervised fleet warms restarted replicas
+    concurrently with readiness, and conservation holds throughout."""
+
+    def _build(self, *, warm_rids=("r0", "r1"), exporter=None):
+        from scaletorch_tpu.serving.gateway import ServingGateway
+        from scaletorch_tpu.serving.supervisor import ReplicaSupervisor
+
+        chain_arg = ",".join(str(t) for t in CHAIN)
+        env = child_env()
+
+        def spawn(rid):
+            cmd = [sys.executable, FAKE_REPLICA, "--replica_id", rid,
+                   "--token_delay_s", "0.01"]
+            if rid in warm_rids:
+                cmd += ["--warm_chain", chain_arg]
+            return subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, env=env)
+
+        sup = ReplicaSupervisor(
+            spawn, ["r0", "r1"],
+            worker_factory=lambda rid, port, proc: RemoteEngineWorker(
+                "127.0.0.1", port, replica_id=rid, proc=proc,
+                poll_interval_s=0.03).start(),
+            poll_interval_s=0.01, backoff_base_s=0.05, backoff_max_s=0.2,
+            backoff_jitter=0.0, flap_window_s=0.5, flap_max_restarts=30,
+            ready_timeout_s=30.0, rng=random.Random(0))
+        workers = sup.start()
+        gw = ServingGateway(workers, port=0, supervisor=sup,
+                            max_backlog=512,
+                            exporter=exporter).start_in_thread()
+        return gw, sup
+
+    def _kill_child(self, sup, rid):
+        with sup._lock:
+            rep = sup._replicas[rid]
+            assert rep.proc is not None
+            rep.proc.kill()
+
+    def test_restart_warms_from_peer(self):
+        exporter = RecordingExporter()
+        # only r0 can donate: the restarted r1 must get ITS pages
+        gw, sup = self._build(warm_rids=("r0",), exporter=exporter)
+        try:
+            self._kill_child(sup, "r1")
+            wait_for(lambda: all(
+                st["state"] == "up" for st in sup.status().values()),
+                timeout=30, msg="fleet healed")
+            wait_for(
+                lambda: any(r.get("replica") == "r1"
+                            for r in exporter.of_kind("warmup")),
+                timeout=30, msg="warmup event")
+            record = [r for r in exporter.of_kind("warmup")
+                      if r.get("replica") == "r1"][0]
+            assert record["status"] == "warmed"
+            assert record["donor"] == "r0"
+            assert record["pages"] == 2
+            # the warmed pages surface on the gateway's health + metrics
+            health = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/healthz",
+                timeout=10).read())
+            wait_for(lambda: json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/healthz", timeout=10
+            ).read())["replicas"]["r1"].get("warm_pages") == 2,
+                timeout=15, msg="healthz warm_pages")
+            metrics = urllib.request.urlopen(
+                f"http://127.0.0.1:{gw.port}/metrics",
+                timeout=10).read().decode()
+            assert 'replica_warm_pages_total{replica="r1"}' in metrics
+            assert "warm_transfer_seconds" in metrics
+            # first post-restart shared-prefix request: one terminal,
+            # correct bytes, and a prefix hit on the warmed chain
+            body = json.dumps({"prompt": CHAIN + [2],
+                               "max_new_tokens": 4,
+                               "stream": False}).encode()
+            resp = urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{gw.port}/v1/generate", data=body,
+                method="POST"), timeout=30)
+            payload = json.loads(resp.read())
+            oracle = FakeEngineWorker()
+            assert payload["outcome"] == "ok"
+            assert payload["token_ids"] == \
+                oracle.expected_tokens(CHAIN + [2], 4)
+            # the prefix hit shows on the request's access record
+            wait_for(lambda: any(
+                r["outcome"] == "ok" and r["prefix_hit"]
+                for r in exporter.of_kind("access")),
+                timeout=10, msg="prefix-hit access record")
+            gw.metrics.check_conservation()
+            assert health["replicas"]["r0"]["state"] == "up"
+        finally:
+            gw.stop_sync()
+            sup.stop(drain=False)
+
+    def test_no_live_peers_degrades_to_cold_rejoin(self):
+        exporter = RecordingExporter()
+        gw, sup = self._build(warm_rids=(), exporter=exporter)
+        try:
+            # kill BOTH children: whichever rejoins first has no live
+            # peer to pull from and must still come up cold
+            self._kill_child(sup, "r0")
+            self._kill_child(sup, "r1")
+            wait_for(lambda: all(
+                st["state"] == "up" for st in sup.status().values()),
+                timeout=30, msg="fleet healed")
+            wait_for(lambda: len(exporter.of_kind("warmup")) >= 2,
+                     timeout=30, msg="warmup events")
+            statuses = {r["status"] for r in exporter.of_kind("warmup")}
+            assert statuses <= {"cold"}
+            # cold but SERVING: the fleet still answers correctly
+            body = json.dumps({"prompt": [11, 7], "max_new_tokens": 5,
+                               "stream": False}).encode()
+            payload = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{gw.port}/v1/generate",
+                    data=body, method="POST"), timeout=30).read())
+            oracle = FakeEngineWorker()
+            assert payload["outcome"] == "ok"
+            assert payload["token_ids"] == \
+                oracle.expected_tokens([11, 7], 5)
+            gw.metrics.check_conservation()
+        finally:
+            gw.stop_sync()
+            sup.stop(drain=False)
+
+    def test_conservation_through_randomized_kill9_with_warming(self):
+        """The ISSUE drill: a seeded random kill -9 schedule interleaves
+        restarts (each spawning a warm pull) with live traffic — every
+        HTTP request still gets exactly one terminal and the gateway
+        ledger balances."""
+        exporter = RecordingExporter()
+        gw, sup = self._build(exporter=exporter)
+        rng = random.Random(20240806)
+        stop_killing = threading.Event()
+        kills = []
+
+        def killer():
+            while not stop_killing.is_set():
+                time.sleep(rng.uniform(0.15, 0.4))
+                with sup._lock:
+                    up = [r for r in sup._replicas.values()
+                          if r.state == "up" and r.proc is not None
+                          and r.proc.poll() is None]
+                if not up:
+                    continue
+                victim = rng.choice(up)
+                victim.proc.kill()
+                kills.append(victim.replica_id)
+
+        outcomes = []
+
+        def client(seed):
+            crng = random.Random(seed)
+            for _ in range(6):
+                if crng.random() < 0.5:  # ride the warmed prefix chain
+                    prompt = CHAIN + [crng.randrange(1, 50)]
+                else:
+                    prompt = [crng.randrange(1, 50)
+                              for _ in range(crng.randrange(1, 5))]
+                body = json.dumps({
+                    "prompt": prompt,
+                    "max_new_tokens": crng.randrange(4, 20),
+                    "stream": False}).encode()
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{gw.port}/v1/generate",
+                    data=body, method="POST")
+                try:
+                    resp = urllib.request.urlopen(req, timeout=30)
+                    payload = json.loads(resp.read())
+                except urllib.error.HTTPError as err:
+                    payload = json.loads(err.read())
+                outcomes.append(payload["outcome"])
+
+        kt = threading.Thread(target=killer, daemon=True)
+        kt.start()
+        clients = [threading.Thread(target=client, args=(s,), daemon=True)
+                   for s in range(4)]
+        try:
+            for t in clients:
+                t.start()
+            for t in clients:
+                t.join(timeout=120)
+                assert not t.is_alive(), "client wedged without terminal"
+            stop_killing.set()
+            kt.join(timeout=5)
+            assert len(outcomes) == 24  # exactly one terminal each
+            assert kills, "the schedule never actually killed a child"
+            gw.metrics.check_conservation()
+            wait_for(lambda: all(
+                st["state"] == "up" for st in sup.status().values()),
+                timeout=30, msg="fleet healed")
+            # every restart attempted a warm rejoin (any status: a
+            # concurrently-dying donor legitimately ends cold)
+            wait_for(
+                lambda: len(exporter.of_kind("warmup")) >= len(set(kills)),
+                timeout=30, msg="warmup attempts recorded")
+            for record in exporter.of_kind("warmup"):
+                assert record["status"] in ("warmed", "partial", "cold")
+        finally:
+            stop_killing.set()
+            gw.stop_sync()
+            sup.stop(drain=False)
