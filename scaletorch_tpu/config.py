@@ -557,6 +557,41 @@ class ResilienceArguments:
                           "rollback-budget exhaustion and watchdog "
                           "fires."},
     )
+    # Elastic continuation (resilience_distributed.ElasticCoordinator):
+    # survive host loss by remeshing onto the survivors, not restarting
+    elastic: bool = field(
+        default=False,
+        metadata={"help": "Elastic training fleet: when a host dies or "
+                          "hangs past elastic_deadline_seconds, the "
+                          "survivors agree a new membership epoch, "
+                          "shrink the dp axis, restore from the latest "
+                          "checkpoint onto the smaller mesh and continue "
+                          "to total_train_steps; relaunched hosts rejoin "
+                          "at the next checkpoint boundary. Requires "
+                          "--resume auto|must and a checkpoint_dir, and "
+                          "a geometry whose dp divides by the host count "
+                          "(tp/pp/cp/ep must not span hosts)."},
+    )
+    elastic_min_hosts: int = field(
+        default=1,
+        metadata={"help": "Refuse to continue (abort to the fleet-restart "
+                          "fallback, exit 43) when a shrink would leave "
+                          "fewer than this many hosts."},
+    )
+    elastic_heartbeat_seconds: float = field(
+        default=2.0,
+        metadata={"help": "Cadence of each host's liveness heartbeat file "
+                          "in the membership store (operator-visible "
+                          "only; detection itself is the bounded "
+                          "deadline on every epoch-bus collective)."},
+    )
+    elastic_deadline_seconds: float = field(
+        default=10.0,
+        metadata={"help": "Bounded deadline on elastic epoch-bus "
+                          "collectives and suspect rounds: a peer that "
+                          "misses it is declared lost and the fleet "
+                          "remeshes without it."},
+    )
     # Fault injection (testing/drills; env vars SCALETORCH_TPU_FT_* override)
     ft_nan_at_step: int = field(
         default=0,
@@ -615,6 +650,36 @@ class ResilienceArguments:
         metadata={"help": "Duration of the injected ft_slow_step_at_step "
                           "stall. Env override: "
                           "SCALETORCH_TPU_FT_SLOW_STEP_SECONDS."},
+    )
+    ft_kill_host_at_step: int = field(
+        default=0,
+        metadata={"help": "Elastic drill: hard-kill the ft_kill_host-"
+                          "selected host after optimizer step k (0 = "
+                          "off; fires once) — survivors must remesh and "
+                          "continue. Env override: "
+                          "SCALETORCH_TPU_FT_KILL_HOST_STEP."},
+    )
+    ft_kill_host: int = field(
+        default=-1,
+        metadata={"help": "Process index the ft_kill_host_at_step / "
+                          "ft_host_hang_elastic drills target (-1 = "
+                          "every host — only meaningful in simulated-"
+                          "host tests). Env override: "
+                          "SCALETORCH_TPU_FT_KILL_HOST."},
+    )
+    ft_host_hang_elastic: int = field(
+        default=0,
+        metadata={"help": "Elastic drill: stall the ft_kill_host-selected "
+                          "host past the elastic epoch-bus deadline once "
+                          "after optimizer step k (0 = off) — the fleet "
+                          "must evict it and it must park-and-rejoin. "
+                          "Env override: "
+                          "SCALETORCH_TPU_FT_HOST_HANG_ELASTIC."},
+    )
+    ft_host_hang_seconds: float = field(
+        default=30.0,
+        metadata={"help": "Duration of the injected ft_host_hang_elastic "
+                          "stall (size it past elastic_deadline_seconds)."},
     )
     # Serving fault injection (inference.resilience.ServingFaultInjector;
     # steps are 1-based DECODE steps of the engine's lifetime)
@@ -724,6 +789,7 @@ class ResilienceArguments:
                      "max_rollbacks", "ft_nan_at_step", "ft_fail_saves",
                      "ft_sigterm_at_step", "ft_hang_at_step",
                      "ft_bad_batch_at_step", "ft_slow_step_at_step",
+                     "ft_kill_host_at_step", "ft_host_hang_elastic",
                      "ft_serve_nan_at_step",
                      "ft_serve_nan_slot", "ft_serve_slow_at_step",
                      "ft_serve_submit_storm_at_step",
@@ -745,6 +811,31 @@ class ResilienceArguments:
             raise ValueError(
                 f"ft_sigterm_host must be -1 (any host) or a process "
                 f"index, got {self.ft_sigterm_host}"
+            )
+        if self.ft_kill_host < -1:
+            raise ValueError(
+                f"ft_kill_host must be -1 (any host) or a process "
+                f"index, got {self.ft_kill_host}"
+            )
+        if self.ft_host_hang_seconds <= 0:
+            raise ValueError(
+                f"ft_host_hang_seconds must be > 0, "
+                f"got {self.ft_host_hang_seconds}"
+            )
+        if self.elastic_min_hosts < 1:
+            raise ValueError(
+                f"elastic_min_hosts must be >= 1, "
+                f"got {self.elastic_min_hosts}"
+            )
+        if self.elastic_heartbeat_seconds <= 0:
+            raise ValueError(
+                f"elastic_heartbeat_seconds must be > 0, "
+                f"got {self.elastic_heartbeat_seconds}"
+            )
+        if self.elastic_deadline_seconds <= 0:
+            raise ValueError(
+                f"elastic_deadline_seconds must be > 0, "
+                f"got {self.elastic_deadline_seconds}"
             )
         if self.ft_slow_step_seconds <= 0:
             raise ValueError(
@@ -1071,6 +1162,40 @@ class ScaleTorchTPUArguments(
         # compat alias for --resume auto (never weaken an explicit 'must').
         if self.resume_from_checkpoint and self.resume == "off":
             self.resume = "auto"
+        if self.elastic:
+            # An elastic remesh IS a restore: every shrink/grow restores
+            # the latest checkpoint onto the new topology, so a config
+            # that cannot resume — or whose geometry cannot shed a host —
+            # must be refused at parse time, not at the first host loss.
+            if not self.checkpoint_dir:
+                raise ValueError(
+                    "--elastic requires --checkpoint_dir: every membership "
+                    "transition restores from the latest checkpoint"
+                )
+            if self.resume == "off":
+                raise ValueError(
+                    "--elastic requires --resume auto|must: survivors (and "
+                    "relaunched hosts) continue by restoring, never from "
+                    "scratch"
+                )
+            if self.num_processes:
+                if self.elastic_min_hosts > self.num_processes:
+                    raise ValueError(
+                        f"--elastic_min_hosts {self.elastic_min_hosts} > "
+                        f"--num_processes {self.num_processes}: the fleet "
+                        "could never satisfy its own floor — lower "
+                        "elastic_min_hosts or launch more hosts"
+                    )
+                if (self.num_processes > 1
+                        and self.data_parallel_size % self.num_processes):
+                    raise ValueError(
+                        f"--elastic needs data_parallel_size "
+                        f"{self.data_parallel_size} divisible by "
+                        f"num_processes {self.num_processes} so each host "
+                        "holds whole dp replicas; otherwise tp/pp/cp/ep "
+                        "span hosts and no host can be shed — raise dp or "
+                        "disable --elastic"
+                    )
         if self.sequence_length % self.context_parallel_size != 0:
             raise ValueError(
                 f"sequence_length {self.sequence_length} not divisible by "

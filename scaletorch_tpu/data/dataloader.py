@@ -29,6 +29,49 @@ from scaletorch_tpu.utils.logger import get_logger
 Batch = Dict[str, np.ndarray]
 
 
+def remap_loader_position(
+    position: int,
+    *,
+    old_samples_per_step: int,
+    new_samples_per_step: int,
+) -> int:
+    """Translate a checkpointed ``loader_position`` (optimizer steps
+    consumed) across a dp-degree change (elastic remesh).
+
+    ``position`` counts optimizer steps, and one step consumes
+    ``samples_per_step = micro_bs * dp * accum`` sequences — a quantity
+    that changes when the dp axis shrinks or grows. The intra-epoch
+    sample stream itself is dp-independent (one seeded permutation of
+    the full dataset per epoch), so the consumed *region* is
+    ``position * old_samples_per_step`` sequences, and the equivalent
+    step count under the new geometry is that region divided by the new
+    step size — rounded UP, so a partially-covered step batch counts as
+    retired and is never re-consumed (double-counting a batch corrupts
+    the deterministic trajectory; skipping strictly fewer than one new
+    step batch of samples on a non-divisible boundary is logged and
+    benign). A shrink to a divisor dp (e.g. dp4 -> dp2) is always exact.
+    """
+    if old_samples_per_step <= 0 or new_samples_per_step <= 0:
+        raise ValueError(
+            "samples_per_step must be positive, got "
+            f"{old_samples_per_step} -> {new_samples_per_step}"
+        )
+    if position < 0:
+        raise ValueError(f"loader position must be >= 0, got {position}")
+    samples = position * old_samples_per_step
+    new_position = -(-samples // new_samples_per_step)  # ceil division
+    skipped = new_position * new_samples_per_step - samples
+    if skipped:
+        get_logger().warning(
+            f"elastic loader remap: {position} steps x "
+            f"{old_samples_per_step} samples does not divide by the new "
+            f"step size {new_samples_per_step}; rounding up to step "
+            f"{new_position} retires {skipped} extra sample(s) (< 1 step "
+            "batch) rather than double-counting a consumed batch"
+        )
+    return new_position
+
+
 class MicroBatchDataLoader:
     """Yields per-optimizer-step batches from a [N, seq+1] token array.
 
@@ -99,6 +142,27 @@ class MicroBatchDataLoader:
         # Epoch-dependent seeding = DistributedSampler.set_epoch parity.
         rng = np.random.default_rng(self.seed + self.epoch)
         return rng.permutation(len(self.tokens))
+
+    def set_data_parallel_size(self, data_parallel_size: int) -> None:
+        """Elastic remesh hook: adopt a new dp degree in place. Only the
+        step GEOMETRY changes (global batch, samples per step); the
+        epoch permutation is dp-independent, so the stream itself is
+        untouched — the caller re-seats ``position`` via
+        ``remap_loader_position`` + ``set_state`` and drops any live
+        iterator."""
+        if data_parallel_size < 1:
+            raise ValueError(
+                f"data_parallel_size must be >= 1, got {data_parallel_size}"
+            )
+        self.dp = data_parallel_size
+        self.global_batch_size = self.micro_batch_size * data_parallel_size
+        self.samples_per_step = self.global_batch_size * self.grad_accum
+        if len(self.tokens) < self.samples_per_step:
+            raise ValueError(
+                f"dataset has {len(self.tokens)} sequences < "
+                f"{self.samples_per_step} needed per step after the dp "
+                "change"
+            )
 
     def set_state(self, steps_consumed: int) -> None:
         """Fast-forward to just after ``steps_consumed`` optimizer steps —
@@ -215,6 +279,16 @@ class SyntheticDataLoader:
         self.dp = data_parallel_size
         self.global_batch_size = micro_batch_size * data_parallel_size
         self.rng = np.random.default_rng(seed)
+
+    def set_data_parallel_size(self, data_parallel_size: int) -> None:
+        """Elastic remesh hook — same contract as MicroBatchDataLoader's
+        (the synthetic stream has no position to re-seat)."""
+        if data_parallel_size < 1:
+            raise ValueError(
+                f"data_parallel_size must be >= 1, got {data_parallel_size}"
+            )
+        self.dp = data_parallel_size
+        self.global_batch_size = self.micro_batch_size * data_parallel_size
 
     @property
     def tokens_per_step(self) -> int:

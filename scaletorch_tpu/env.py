@@ -112,6 +112,13 @@ register_env("SCALETORCH_TPU_FT_HANG_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_BAD_BATCH_STEP", "0", int)
 register_env("SCALETORCH_TPU_FT_HANG_TIMEOUT", "0", float)
 register_env("SCALETORCH_TPU_FT_COORDINATE", "1", _as_bool)
+# Elastic drills (resilience_distributed.ElasticCoordinator): hard-kill
+# one host after step k (survivors remesh and continue), or stall one
+# host past the elastic epoch-bus deadline (the fleet evicts it and it
+# must park-and-rejoin). KILL_HOST selects the target rank for both.
+register_env("SCALETORCH_TPU_FT_KILL_HOST_STEP", "0", int)
+register_env("SCALETORCH_TPU_FT_KILL_HOST", "-1", int)
+register_env("SCALETORCH_TPU_FT_HOST_HANG_ELASTIC", "0", int)
 # Serving fault injection (inference/resilience.ServingFaultInjector):
 # same present-wins contract over the ft_serve_* config fields; steps are
 # 1-based decode steps of the engine's lifetime.

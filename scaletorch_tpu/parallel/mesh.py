@@ -207,6 +207,47 @@ class MeshManager:
         )
 
 
+# ---- elastic remesh (resilience_distributed.ElasticCoordinator) -------------
+
+
+class MeshShrinkError(ValueError):
+    """The requested host-count change cannot be absorbed by the dp
+    axis — the loud abort to the fleet-restart fallback (train.py maps
+    the elastic abort to the restartable exit code)."""
+
+
+def elastic_mesh_kwargs(
+    kwargs: dict, *, hosts_before: int, hosts_after: int
+) -> dict:
+    """Axis sizes for a fleet that changed host count: shrink (or grow)
+    the dp axis first, leaving tp/pp/cp/ep untouched.
+
+    The elastic contract is that dp is the only host-spanning axis:
+    every host carries ``dp / hosts`` whole data-parallel replicas and
+    the model axes (tp/pp/cp/ep) live inside a host. Then losing (or
+    readmitting) hosts maps cleanly onto retiring (or adding) whole dp
+    replicas. A geometry that breaks the contract — dp does not divide
+    by the host count, i.e. tp/pp/cp/ep span hosts — raises
+    ``MeshShrinkError`` with the fix spelled out; config.py rejects
+    such geometries at parse time when ``--elastic`` is set.
+    """
+    if hosts_before < 1 or hosts_after < 1:
+        raise MeshShrinkError(
+            f"host counts must be >= 1, got {hosts_before} -> {hosts_after}")
+    dp = int(kwargs.get("dp", 1))
+    if dp % hosts_before != 0:
+        raise MeshShrinkError(
+            f"elastic remesh needs dp divisible by the host count so every "
+            f"host holds whole dp replicas (dp={dp}, hosts={hosts_before}): "
+            "tp/pp/cp/ep would span hosts and cannot shrink — falling back "
+            "to a fleet restart"
+        )
+    per_host = dp // hosts_before
+    out = dict(kwargs)
+    out["dp"] = per_host * hosts_after
+    return out
+
+
 # ---- global singleton (parity: ProcessGroupManagerProxy, process_group.py:359-405)
 _instance: Optional[MeshManager] = None
 

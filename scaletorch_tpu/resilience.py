@@ -295,6 +295,13 @@ def retry_with_backoff(
 # --------------------------------------------------------------------------
 
 
+class HostKilledError(BaseException):
+    """Raised by a test-mode ``deliver_kill`` to unwind a simulated host
+    thread the way os._exit(1) removes a real process: NOT an Exception
+    subclass, so no resilience handler between the injection site and
+    the host's top level can swallow it."""
+
+
 @dataclass
 class FaultInjector:
     """Config/env-driven fault hooks. All knobs default to off (0).
@@ -312,12 +319,20 @@ class FaultInjector:
     * ``bad_batch_at_step`` — every read of stream position k raises a
       retriable I/O error (a corrupt shard: deterministic, so retries
       fail and the loader's skip-and-log path must retire the region).
+    * ``kill_host_at_step`` / ``kill_host`` — hard-kill exactly one host
+      after optimizer step k (the elastic drill: survivors must remesh
+      and continue, not restart the fleet).
+    * ``host_hang_elastic`` — stall the ``kill_host``-selected host past
+      the elastic epoch-bus deadline once after step k, so the fleet
+      evicts a live-but-wedged peer (it later rejoins).
 
     Env overrides (taking precedence over config so a running job can be
     probed without a config edit): ``SCALETORCH_TPU_FT_NAN_STEP``,
     ``SCALETORCH_TPU_FT_FAIL_SAVES``, ``SCALETORCH_TPU_FT_SIGTERM_STEP``,
     ``SCALETORCH_TPU_FT_SIGTERM_HOST``, ``SCALETORCH_TPU_FT_HANG_STEP``,
-    ``SCALETORCH_TPU_FT_BAD_BATCH_STEP``.
+    ``SCALETORCH_TPU_FT_BAD_BATCH_STEP``,
+    ``SCALETORCH_TPU_FT_KILL_HOST_STEP``, ``SCALETORCH_TPU_FT_KILL_HOST``,
+    ``SCALETORCH_TPU_FT_HOST_HANG_ELASTIC``.
     """
 
     nan_at_step: int = 0
@@ -329,6 +344,10 @@ class FaultInjector:
     bad_batch_at_step: int = 0
     slow_step_at_step: int = 0
     slow_step_seconds: float = 0.5
+    kill_host_at_step: int = 0
+    kill_host: int = -1
+    host_hang_elastic: int = 0
+    host_hang_seconds: float = 30.0
     # host identity for the one-host drills; None = resolve from the JAX
     # runtime lazily (fake-host tests set it explicitly)
     host_index: Optional[int] = None
@@ -336,11 +355,18 @@ class FaultInjector:
     # host-local PreemptionHandler.trigger; None = real os.kill)
     deliver_signal: Optional[Callable[[int], None]] = field(
         default=None, repr=False)
+    # kill delivery override for simulated hosts (tests raise a
+    # HostKilledError that unwinds the host thread; None = os._exit(1),
+    # the crash-family exit the elastic launcher relaunches per-rank)
+    deliver_kill: Optional[Callable[[], None]] = field(
+        default=None, repr=False)
     nan_fired_step: Optional[int] = field(default=None, repr=False)
     _nan_fired: bool = field(default=False, repr=False)
     _sigterm_fired: bool = field(default=False, repr=False)
     _hang_fired: bool = field(default=False, repr=False)
     _slow_fired: bool = field(default=False, repr=False)
+    _kill_fired: bool = field(default=False, repr=False)
+    _elastic_hang_fired: bool = field(default=False, repr=False)
 
     @classmethod
     def from_config(cls, cfg) -> "FaultInjector":
@@ -371,13 +397,22 @@ class FaultInjector:
             slow_step_seconds=float(env_override(
                 "SCALETORCH_TPU_FT_SLOW_STEP_SECONDS",
                 getattr(cfg, "ft_slow_step_seconds", 0.5))),
+            kill_host_at_step=env_or("SCALETORCH_TPU_FT_KILL_HOST_STEP",
+                                     "ft_kill_host_at_step"),
+            kill_host=env_or("SCALETORCH_TPU_FT_KILL_HOST",
+                             "ft_kill_host", default=-1),
+            host_hang_elastic=env_or("SCALETORCH_TPU_FT_HOST_HANG_ELASTIC",
+                                     "ft_host_hang_elastic"),
+            host_hang_seconds=float(
+                getattr(cfg, "ft_host_hang_seconds", 30.0)),
         )
 
     @property
     def active(self) -> bool:
         return bool(self.nan_at_step or self.fail_saves
                     or self.sigterm_at_step or self.hang_at_step
-                    or self.bad_batch_at_step or self.slow_step_at_step)
+                    or self.bad_batch_at_step or self.slow_step_at_step
+                    or self.kill_host_at_step or self.host_hang_elastic)
 
     def _host(self) -> int:
         if self.host_index is not None:
@@ -428,6 +463,47 @@ class FaultInjector:
                 f"after step {step}"
             )
             time.sleep(self.hang_seconds)
+
+    def maybe_kill(self, step: int) -> None:
+        """Elastic drill: hard-kill the ``kill_host``-selected host after
+        optimizer step k. Fires BEFORE the decision gather, so the dead
+        host simply never shows up in its peers' collective — the
+        host-loss shape the elastic coordinator remeshes around. Default
+        delivery is ``os._exit(1)`` (crash-family exit: the elastic
+        launcher relaunches just this rank); tests override
+        ``deliver_kill`` to unwind the simulated host thread."""
+        if self.kill_host_at_step and step == self.kill_host_at_step \
+                and not self._kill_fired:
+            if self.kill_host >= 0 and self._host() != self.kill_host:
+                return  # the drill kills exactly one worker
+            self._kill_fired = True
+            get_logger().warning(
+                f"fault injection: killing host {self._host()} after "
+                f"step {step}"
+            )
+            if self.deliver_kill is not None:
+                self.deliver_kill()
+            else:
+                os._exit(1)
+
+    def maybe_elastic_hang(self, step: int) -> None:
+        """Elastic drill: stall the ``kill_host``-selected host past the
+        epoch-bus deadline once, so its peers' collective times out and
+        the fleet evicts a live-but-wedged peer (which must then park
+        and rejoin). Unlike ``maybe_hang`` — the whole-run watchdog
+        drill — this is scoped to one host and sized against the
+        elastic deadline, not the watchdog timeout."""
+        if self.host_hang_elastic and step == self.host_hang_elastic \
+                and not self._elastic_hang_fired:
+            if self.kill_host >= 0 and self._host() != self.kill_host:
+                return
+            self._elastic_hang_fired = True
+            get_logger().warning(
+                f"fault injection: host {self._host()} hanging "
+                f"{self.host_hang_seconds:g}s across the elastic "
+                f"deadline after step {step}"
+            )
+            time.sleep(self.host_hang_seconds)
 
     def maybe_slow_step(self, step: int) -> None:
         """Telemetry drill: stall step ``step`` at its boundary once, so

@@ -26,6 +26,19 @@ lifts those gates:
     (sentinel abort, rollback budget exhausted, watchdog fired) under
     ``results/crash_report_step<N>.json`` so diagnosis never depends on
     scrollback.
+  * ``ElasticCoordinator`` — the membership epoch state machine
+    (steady → suspect → shrink → steady → grow) that lets the training
+    fleet survive host loss by remeshing instead of restarting: a
+    collective that loses a participant surfaces as ``PeerLostError``,
+    the survivors agree a new membership epoch through a write-once
+    epoch record (the host-0-agreed-and-broadcast idiom mapped onto
+    shared storage), rebuild their decision bus over the survivor set,
+    and the trainer restores from the latest checkpoint onto the
+    shrunken topology and continues to the same absolute step target.
+    A relaunched replacement host parks at the rejoin barrier and the
+    fleet scales back up at the next checkpoint boundary via the same
+    epoch machinery (``maybe_grow`` — the decision rides the epoch bus
+    so every member switches at the same boundary).
 
 Exit-code contract (documented in docs/fault_tolerance.md and consumed
 by scripts/launch_multihost.sh):
@@ -117,6 +130,635 @@ class DecisionBus:
 
 
 # --------------------------------------------------------------------------
+# Elastic membership (survive host loss by remeshing, not restarting)
+# --------------------------------------------------------------------------
+
+
+class PeerLostError(RuntimeError):
+    """A host-level collective lost a participant: a peer's contribution
+    never landed within the bounded deadline (dead host, broken barrier,
+    torn transport). In elastic mode the trainer's outer loop catches
+    this and runs the membership recovery protocol; non-elastic it
+    propagates like any other fatal transport error."""
+
+    def __init__(self, message: str, missing: tuple = ()) -> None:
+        super().__init__(message)
+        self.missing = tuple(missing)
+
+
+class ElasticRemeshError(RuntimeError):
+    """Elastic continuation is impossible (membership below
+    ``--elastic_min_hosts``, an un-shrinkable mesh, no epoch agreement
+    within the deadline). The loud abort to the fleet-restart fallback:
+    train.py maps it to the restartable exit code (43), so a non-elastic
+    launcher policy — full fleet relaunch from the last checkpoint —
+    takes over exactly where remeshing gave up."""
+
+
+@dataclass(frozen=True)
+class MembershipView:
+    """One membership epoch: which global ranks are in the fleet."""
+
+    epoch: int
+    members: tuple  # sorted global ranks
+
+    @property
+    def num_hosts(self) -> int:
+        return len(self.members)
+
+    def bus_index(self, rank: int) -> int:
+        """This rank's process index WITHIN the epoch (host 0 of an
+        epoch is its lowest surviving global rank)."""
+        return self.members.index(rank)
+
+
+def _read_json(path: str) -> Optional[dict]:
+    """Tolerant JSON read: a missing, torn or half-written file is
+    ``None`` (membership files are written atomically, but a reader may
+    race the final rename on a laggy shared filesystem)."""
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return obj if isinstance(obj, dict) else None
+
+
+class FileMembershipStore:
+    """Membership rendezvous over a shared directory (the checkpoint
+    filesystem the fleet already shares). Four small surfaces, all
+    per-rank files written atomically:
+
+      * epoch records ``epoch_<n>.json`` — write-once (hard-link
+        publish): the FIRST proposal for an epoch wins and every rank
+        adopts what the record says, never its own local guess;
+      * alive posts ``alive_e<n>_r<rank>.json`` — one per suspect round;
+      * rejoin requests ``rejoin_r<rank>.json`` — the park barrier;
+      * heartbeats ``heartbeat_r<rank>.json`` — operator-visible
+        liveness, refreshed at most once per ``heartbeat_seconds``.
+
+    A relaunching FLEET (as opposed to a relaunching rank) must clear
+    this directory first — stale epoch records would park ranks that
+    the dead epoch excluded (scripts/launch_multihost.sh does this on
+    every full-fleet (re)launch)."""
+
+    def __init__(self, directory: str) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    # -- atomic write helpers ------------------------------------------------
+
+    def _publish(self, name: str, record: dict, *, exclusive: bool) -> bool:
+        """Write ``record`` to ``name`` atomically. ``exclusive`` uses a
+        hard-link publish so the first writer wins (epoch records);
+        otherwise the newest write wins (per-rank files)."""
+        tmp = os.path.join(
+            self.directory, f".tmp_{name}_{os.getpid()}_{threading.get_ident()}"
+        )
+        final = os.path.join(self.directory, name)
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            if exclusive:
+                try:
+                    os.link(tmp, final)
+                except FileExistsError:
+                    return False
+                finally:
+                    os.unlink(tmp)
+                return True
+            os.replace(tmp, final)
+            return True
+        except OSError as exc:
+            get_logger().error(
+                f"membership store write {name} failed: {exc!r}")
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    # -- epoch records -------------------------------------------------------
+
+    def propose_epoch(self, record: dict) -> bool:
+        """Publish a write-once epoch record; False when an epoch with
+        this number already exists (the race loser adopts the winner)."""
+        return self._publish(
+            f"epoch_{int(record['epoch']):08d}.json", record, exclusive=True)
+
+    def epoch(self, n: int) -> Optional[dict]:
+        return _read_json(
+            os.path.join(self.directory, f"epoch_{int(n):08d}.json"))
+
+    def latest_epoch(self) -> Optional[dict]:
+        best: Optional[dict] = None
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return None
+        for name in names:
+            if not (name.startswith("epoch_") and name.endswith(".json")):
+                continue
+            rec = _read_json(os.path.join(self.directory, name))
+            if rec is not None and (
+                    best is None or rec.get("epoch", -1) > best["epoch"]):
+                best = rec
+        return best
+
+    # -- suspect rounds ------------------------------------------------------
+
+    def post_alive(self, epoch: int, rank: int, step: Optional[int]) -> None:
+        self._publish(
+            f"alive_e{int(epoch):08d}_r{int(rank)}.json",
+            {"rank": int(rank), "epoch": int(epoch), "step": step,
+             "time": time.time()},
+            exclusive=False,
+        )
+
+    def alive_set(self, epoch: int) -> set:
+        prefix = f"alive_e{int(epoch):08d}_r"
+        out = set()
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith(prefix) and name.endswith(".json"):
+                try:
+                    out.add(int(name[len(prefix):-len(".json")]))
+                except ValueError:
+                    continue
+        return out
+
+    # -- rejoin mailbox ------------------------------------------------------
+
+    def request_rejoin(self, rank: int) -> None:
+        self._publish(
+            f"rejoin_r{int(rank)}.json",
+            {"rank": int(rank), "time": time.time()}, exclusive=False)
+
+    def pending_rejoins(self) -> List[int]:
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if name.startswith("rejoin_r") and name.endswith(".json"):
+                try:
+                    out.append(int(name[len("rejoin_r"):-len(".json")]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def clear_rejoin(self, rank: int) -> None:
+        try:
+            os.unlink(os.path.join(self.directory, f"rejoin_r{int(rank)}.json"))
+        except OSError:
+            pass
+
+    # -- heartbeats ----------------------------------------------------------
+
+    def heartbeat(self, rank: int, *, step: Optional[int],
+                  epoch: int) -> None:
+        self._publish(
+            f"heartbeat_r{int(rank)}.json",
+            {"rank": int(rank), "step": step, "epoch": int(epoch),
+             "time": time.time()},
+            exclusive=False,
+        )
+
+
+class FileBus:
+    """Deadline-bounded object collectives over the membership store's
+    shared directory — the reference ``bus_factory`` transport for
+    post-remesh epochs (the runtime object collectives the steady bus
+    rides cannot span a membership change). Each collective is one
+    monotone sequence number per epoch: every member publishes
+    ``col_e<epoch>_s<seq>_r<rank>.json`` and polls for its peers' files;
+    a peer whose file never lands within ``deadline`` raises
+    ``PeerLostError`` naming the missing ranks — the elastic detection
+    signal, by construction rather than by watchdog."""
+
+    def __init__(self, directory: str, *, epoch: int, members: tuple,
+                 rank: int, deadline: float, poll: float = 0.005) -> None:
+        self.directory = directory
+        self.epoch = int(epoch)
+        self.members = tuple(members)
+        self.rank = int(rank)
+        self.deadline = float(deadline)
+        self.poll = float(poll)
+        self._seq = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, seq: int, rank: int) -> str:
+        return os.path.join(
+            self.directory,
+            f"col_e{self.epoch:08d}_s{seq:08d}_r{rank}.json")
+
+    def _exchange(self, payload: Any) -> List[Any]:
+        seq = self._seq
+        self._seq += 1
+        tmp = self._path(seq, self.rank) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"v": payload}, f)
+        os.replace(tmp, self._path(seq, self.rank))
+        deadline = time.monotonic() + self.deadline
+        out: Dict[int, Any] = {self.rank: payload}
+        while len(out) < len(self.members):
+            for rank in self.members:
+                if rank in out:
+                    continue
+                rec = _read_json(self._path(seq, rank))
+                if rec is not None:
+                    out[rank] = rec.get("v")
+            if len(out) == len(self.members):
+                break
+            if time.monotonic() >= deadline:
+                missing = tuple(r for r in self.members if r not in out)
+                raise PeerLostError(
+                    f"collective e{self.epoch} s{seq} lost rank(s) "
+                    f"{list(missing)}: no contribution within "
+                    f"{self.deadline:g}s", missing=missing)
+            time.sleep(self.poll)
+        # everyone has read seq-1 before writing seq, so once seq is
+        # complete our own seq-1 file has no remaining readers
+        if seq > 0:
+            try:
+                os.unlink(self._path(seq - 1, self.rank))
+            except OSError:
+                pass
+        return [out[r] for r in self.members]
+
+    def all_gather(self, obj: Any) -> List[Any]:
+        return self._exchange(obj)
+
+    def broadcast(self, objs: list, src: int = 0) -> list:
+        gathered = self._exchange(list(objs))
+        return list(gathered[src])
+
+
+def _elastic_wrap(fn: Callable) -> Callable:
+    """Translate transport-native participant loss (a test bus's broken
+    barrier, a torn socket) into ``PeerLostError`` so the trainer's
+    outer loop catches ONE exception type regardless of transport."""
+
+    def call(*args):
+        try:
+            return fn(*args)
+        except PeerLostError:
+            raise
+        except (threading.BrokenBarrierError, TimeoutError, OSError) as exc:
+            raise PeerLostError(f"collective transport failed: {exc!r}") \
+                from exc
+
+    return call
+
+
+def elastic_decision_bus(view: MembershipView, rank: int,
+                         raw: DecisionBus) -> DecisionBus:
+    """A ``DecisionBus`` over one membership epoch, with participant
+    loss normalised to ``PeerLostError``."""
+    return DecisionBus(
+        num_processes=view.num_hosts,
+        process_index=view.bus_index(rank),
+        all_gather=_elastic_wrap(raw.all_gather),
+        broadcast=_elastic_wrap(raw.broadcast),
+    )
+
+
+class ElasticCoordinator:
+    """The membership epoch state machine: steady → suspect → shrink →
+    steady → grow.
+
+    Detection is the bounded deadline on every epoch-bus collective
+    (``PeerLostError``); agreement is a write-once epoch record in the
+    shared ``FileMembershipStore`` — the first proposal for an epoch
+    wins and every rank adopts what the RECORD says (the
+    host-0-agreed-and-broadcast idiom mapped onto shared storage, so no
+    rank ever acts on a locally-divergent membership guess). Grow
+    decisions additionally ride the live epoch bus (``maybe_grow``), so
+    every member switches topology at the same checkpoint boundary.
+
+    The coordinator owns membership only; the trainer owns what a
+    transition *means* (rebuild mesh/loader, restore from the latest
+    checkpoint onto the new topology — trainer.py's remesh-and-resume
+    outer loop)."""
+
+    def __init__(
+        self,
+        *,
+        rank: int,
+        num_hosts: int,
+        store: FileMembershipStore,
+        bus_factory: Callable[[MembershipView, int], DecisionBus],
+        min_hosts: int = 1,
+        deadline_seconds: float = 10.0,
+        heartbeat_seconds: float = 2.0,
+        join_timeout: float = 600.0,
+        exporter: Any = None,
+        poll: float = 0.02,
+    ) -> None:
+        self.rank = int(rank)
+        self.store = store
+        self.min_hosts = int(min_hosts)
+        self.deadline_seconds = float(deadline_seconds)
+        self.heartbeat_seconds = float(heartbeat_seconds)
+        self.join_timeout = float(join_timeout)
+        self._bus_factory = bus_factory
+        self._exporter = exporter
+        self._poll = float(poll)
+        self._bus: Optional[DecisionBus] = None
+        self._last_beat = -math.inf
+        self.pending_bootstrap = False
+        self._counters: Dict[str, float] = {
+            "elastic_epochs_adopted": 0.0,
+            "elastic_peer_loss_events": 0.0,
+            "elastic_suspect_rounds": 0.0,
+            "elastic_shrinks": 0.0,
+            "elastic_grows": 0.0,
+            "elastic_hosts_lost": 0.0,
+            "elastic_hosts_rejoined": 0.0,
+            "elastic_evictions": 0.0,
+        }
+        latest = self.store.latest_epoch()
+        if latest is None:
+            self.view = MembershipView(0, tuple(range(int(num_hosts))))
+            self.state = "steady"
+            # host-local bookkeeping: publish the founding record so a
+            # later relauncher can tell "fresh fleet" from "evicted"
+            # (write-once — every founding rank proposing is harmless)
+            self.store.propose_epoch({
+                "epoch": 0, "members": list(self.view.members),
+                "reason": "found", "step": None,
+            })
+            self._emit("steady", step=None, lost=(), joined=())
+        else:
+            members = tuple(sorted(int(r) for r in latest["members"]))
+            self.view = MembershipView(int(latest["epoch"]), members)
+            if self.rank in members:
+                self.state = "steady"
+            else:
+                # a relaunched replacement host: park at the rejoin
+                # barrier until a grow epoch admits us
+                self.state = "parked"
+
+    # -- wiring ---------------------------------------------------------------
+
+    @classmethod
+    def from_config(cls, cfg, *, rank: int, num_hosts: int,
+                    exporter: Any = None,
+                    store: Optional[FileMembershipStore] = None,
+                    bus_factory: Optional[Callable] = None,
+                    ) -> "ElasticCoordinator":
+        directory = os.path.join(cfg.checkpoint_dir, "membership")
+        store = store or FileMembershipStore(directory)
+        deadline = float(getattr(cfg, "elastic_deadline_seconds", 10.0))
+
+        if bus_factory is None:
+            def bus_factory(view: MembershipView, rank: int,
+                            _store=store, _deadline=deadline) -> DecisionBus:
+                fb = FileBus(
+                    os.path.join(_store.directory, "collective"),
+                    epoch=view.epoch, members=view.members, rank=rank,
+                    deadline=_deadline,
+                )
+                return DecisionBus(
+                    num_processes=view.num_hosts,
+                    process_index=view.bus_index(rank),
+                    all_gather=fb.all_gather,
+                    broadcast=fb.broadcast,
+                )
+
+        return cls(
+            rank=rank, num_hosts=num_hosts, store=store,
+            bus_factory=bus_factory,
+            min_hosts=int(getattr(cfg, "elastic_min_hosts", 1)),
+            deadline_seconds=deadline,
+            heartbeat_seconds=float(
+                getattr(cfg, "elastic_heartbeat_seconds", 2.0)),
+            exporter=exporter,
+        )
+
+    @property
+    def bus(self) -> DecisionBus:
+        """The decision bus of the CURRENT epoch (participant loss
+        normalised to ``PeerLostError``); rebuilt lazily after every
+        adopted transition."""
+        if self._bus is None:
+            self._bus = elastic_decision_bus(
+                self.view, self.rank, self._bus_factory(self.view, self.rank))
+        return self._bus
+
+    @property
+    def parked(self) -> bool:
+        return self.state == "parked"
+
+    @property
+    def needs_join(self) -> bool:
+        """True when this host must (re)enter the fleet before training:
+        parked at the rejoin barrier, or admitted but not yet restored
+        onto the fleet's checkpoint (``pending_bootstrap``)."""
+        return self.state == "parked" or self.pending_bootstrap
+
+    # -- steady-state ---------------------------------------------------------
+
+    def beat(self, step: Optional[int] = None) -> None:
+        """Operator-visible liveness: refresh this rank's heartbeat file
+        at most once per ``heartbeat_seconds`` (called from the train
+        loop's step boundary — cheap, host-local)."""
+        now = time.monotonic()
+        if now - self._last_beat >= self.heartbeat_seconds:
+            self._last_beat = now
+            self.store.heartbeat(
+                self.rank, step=step, epoch=self.view.epoch)
+
+    # -- transitions ----------------------------------------------------------
+
+    def _emit(self, transition: str, *, step: Optional[int],
+              lost: tuple, joined: tuple) -> None:
+        if self._exporter is not None:
+            self._exporter.emit("membership", {
+                "transition": transition,
+                "epoch": self.view.epoch,
+                "members": list(self.view.members),
+                "num_hosts": self.view.num_hosts,
+                "rank": self.rank,
+                "lost": sorted(lost),
+                "joined": sorted(joined),
+                "step": step,
+            })
+
+    def _adopt(self, record: dict, *, transition: str,
+               step: Optional[int]) -> None:
+        old = self.view
+        members = tuple(sorted(int(r) for r in record["members"]))
+        self.view = MembershipView(int(record["epoch"]), members)
+        self._bus = None
+        self.state = "steady"
+        lost = tuple(r for r in old.members if r not in members)
+        joined = tuple(r for r in members if r not in old.members)
+        self._counters["elastic_epochs_adopted"] += 1
+        self._counters["elastic_hosts_lost"] += len(lost)
+        self._counters["elastic_hosts_rejoined"] += len(joined)
+        if transition == "shrink":
+            self._counters["elastic_shrinks"] += 1
+        elif transition == "grow":
+            self._counters["elastic_grows"] += 1
+        get_logger().warning(
+            f"membership epoch {old.epoch} -> {self.view.epoch} "
+            f"({transition}): members {list(members)}"
+            + (f", lost {list(lost)}" if lost else "")
+            + (f", joined {list(joined)}" if joined else "")
+        )
+        self._emit(transition, step=step, lost=lost, joined=joined)
+
+    def on_peer_lost(self, step: Optional[int],
+                     exc: Optional[BaseException] = None) -> MembershipView:
+        """Membership recovery after a broken collective. Returns the
+        view that includes this host — either the shrink epoch the
+        survivors agreed, or (when THIS host was the one evicted: it
+        hung past the deadline and the fleet moved on) the grow epoch
+        that readmits it after parking at the rejoin barrier. Raises
+        ``ElasticRemeshError`` when the fleet cannot continue."""
+        self._counters["elastic_peer_loss_events"] += 1
+        self.state = "suspect"
+        self._emit("suspect", step=step, lost=(), joined=())
+        get_logger().warning(
+            f"rank {self.rank}: peer lost at step {step} "
+            f"({exc!r}); entering suspect round for epoch "
+            f"{self.view.epoch}"
+        )
+        latest = self.store.latest_epoch()
+        if latest is not None and int(latest["epoch"]) > self.view.epoch:
+            # the fleet already moved on without us (we were the hung
+            # host): adopt if readmitted, else park at the rejoin barrier
+            members = tuple(sorted(int(r) for r in latest["members"]))
+            if self.rank not in members:
+                return self._park_and_rejoin(step)
+            self._adopt(latest, transition="shrink", step=step)
+            return self.view
+        # suspect round: every survivor announces itself, waits out the
+        # deadline, and the FIRST epoch proposal published wins — every
+        # rank adopts the record, never its own locally-observed set
+        self._counters["elastic_suspect_rounds"] += 1
+        self.store.post_alive(self.view.epoch, self.rank, step)
+        deadline = time.monotonic() + self.deadline_seconds
+        alive: set = set()
+        while True:
+            alive = self.store.alive_set(self.view.epoch) \
+                & set(self.view.members)
+            if alive == set(self.view.members):
+                break  # everyone answered: spurious loss, remesh in place
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(self._poll)
+        if not alive:
+            alive = {self.rank}  # store I/O failed: at least we are here
+        target = self.view.epoch + 1
+        if self.rank == min(alive):
+            self.store.propose_epoch({
+                "epoch": target, "members": sorted(alive),
+                "reason": "shrink", "step": step,
+            })
+        record = self._await_epoch(target)
+        if record is None:
+            raise ElasticRemeshError(
+                f"no epoch {target} record appeared within the deadline "
+                f"after a suspect round (alive={sorted(alive)}) — "
+                "falling back to a fleet restart"
+            )
+        members = tuple(sorted(int(r) for r in record["members"]))
+        if self.rank not in members:
+            return self._park_and_rejoin(step)
+        if len(members) < self.min_hosts:
+            self._adopt(record, transition="shrink", step=step)
+            raise ElasticRemeshError(
+                f"membership epoch {record['epoch']} has "
+                f"{len(members)} host(s) < --elastic_min_hosts="
+                f"{self.min_hosts} — falling back to a fleet restart"
+            )
+        self._adopt(record, transition="shrink", step=step)
+        return self.view
+
+    def _await_epoch(self, n: int) -> Optional[dict]:
+        deadline = time.monotonic() + self.deadline_seconds * 2
+        while True:
+            record = self.store.epoch(n)
+            if record is not None:
+                return record
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(self._poll)
+
+    def _park_and_rejoin(self, step: Optional[int]) -> MembershipView:
+        self._counters["elastic_evictions"] += 1
+        self.state = "parked"
+        self._emit("parked", step=step, lost=(self.rank,), joined=())
+        get_logger().warning(
+            f"rank {self.rank}: evicted from the fleet (epoch moved on "
+            "without us); parking at the rejoin barrier"
+        )
+        return self.join(step=step)
+
+    def join(self, step: Optional[int] = None) -> MembershipView:
+        """Park at the rejoin barrier: post a rejoin request and wait
+        for a grow epoch that admits this rank (published by the fleet
+        at a checkpoint boundary), then adopt it. Pre-admitted callers
+        (``maybe_grow`` already readmitted us) return immediately."""
+        if self.state != "parked":
+            return self.view
+        self.store.request_rejoin(self.rank)
+        deadline = time.monotonic() + self.join_timeout
+        while True:
+            latest = self.store.latest_epoch()
+            if (latest is not None
+                    and int(latest["epoch"]) > self.view.epoch
+                    and self.rank in [int(r) for r in latest["members"]]):
+                self._adopt(latest, transition="join", step=step)
+                self.pending_bootstrap = True
+                return self.view
+            if time.monotonic() >= deadline:
+                raise ElasticRemeshError(
+                    f"rank {self.rank} parked at the rejoin barrier for "
+                    f"{self.join_timeout:g}s without being admitted — "
+                    "giving up (fleet gone or grow boundary never reached)"
+                )
+            time.sleep(self._poll)
+
+    def maybe_grow(self, step: Optional[int] = None
+                   ) -> Optional[MembershipView]:
+        """Agreed scale-up at a checkpoint boundary. The epoch's host 0
+        reads the rejoin mailbox and the decision rides the epoch bus —
+        every member learns the SAME joiner set at the SAME boundary —
+        then the grow epoch record admits the parked hosts. Returns the
+        new view, or ``None`` when nobody is waiting."""
+        decision = self.bus.broadcast_from_main(
+            {"joiners": self.store.pending_rejoins(),
+             "epoch": self.view.epoch + 1}
+            if self.bus.is_main else None
+        )
+        joiners = [int(r) for r in (decision or {}).get("joiners", ())
+                   if int(r) not in self.view.members]
+        if not joiners:
+            return None
+        record = {
+            "epoch": int(decision["epoch"]),
+            "members": sorted(set(self.view.members) | set(joiners)),
+            "reason": "grow", "step": step,
+        }
+        if self.bus.is_main:
+            self.store.propose_epoch(record)
+            for rank in joiners:
+                self.store.clear_rejoin(rank)
+        self._adopt(record, transition="grow", step=step)
+        return self.view
+
+    def counters(self) -> Dict[str, float]:
+        return dict(self._counters)
+
+
+# --------------------------------------------------------------------------
 # Coordinated decisions
 # --------------------------------------------------------------------------
 
@@ -203,6 +845,16 @@ class CoordinatedResilience:
         return (self.enabled and self.bus is not None
                 and self.bus.num_processes > 1)
 
+    def rebind_bus(self, bus: Optional[DecisionBus]) -> None:
+        """Swap the decision transport onto a new membership epoch
+        (elastic remesh). Clears the cached stop flag: the first loop
+        boundary of the new epoch pays one fresh ``agree_any`` gather,
+        which is also how the rejoined host and the survivors align
+        their first collective."""
+        self._bus = bus
+        self._bus_probed = True
+        self._stop_agreed = None
+
     # -- stop agreement ----------------------------------------------------
 
     def should_stop(self) -> bool:
@@ -268,6 +920,12 @@ class CoordinatedResilience:
         # watchdog exists for
         mgr.injector.maybe_sigterm(step)
         mgr.injector.maybe_hang(step)
+        # elastic drills: a killed host never reaches the gather below
+        # (its peers' bounded collective raises PeerLostError and the
+        # trainer's remesh-and-resume loop takes over); a hung host
+        # stalls HERE and finds the fleet moved on when it wakes
+        mgr.injector.maybe_kill(step)
+        mgr.injector.maybe_elastic_hang(step)
         forced = mgr.injector.nan_fired_step == step
         sampled = (
             mgr.sentinel is not None and mgr.sentinel_frequency > 0

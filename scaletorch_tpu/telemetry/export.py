@@ -50,10 +50,15 @@ SCHEMA_VERSION = 1
 # sparse per-tenant bucket state, mergeable offline by slo_check).
 # ``warmup`` records one peer-to-peer warm-rejoin attempt per restart
 # (replica, status warmed/partial/cold, donor, pages, seconds,
-# chunks_dropped, attempts). Free-form kinds are allowed; these are the
-# ones consumers can rely on. Adding a kind is additive — v stays 1.
+# chunks_dropped, attempts). ``membership`` records one elastic-fleet
+# transition per rank (resilience_distributed.ElasticCoordinator:
+# transition steady/suspect/shrink/grow/join/parked, epoch, members,
+# num_hosts, rank, lost, joined, step). Free-form kinds are allowed;
+# these are the ones consumers can rely on. Adding a kind is additive —
+# v stays 1.
 KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics",
-               "access", "latency_histograms", "supervisor", "warmup")
+               "access", "latency_histograms", "supervisor", "warmup",
+               "membership")
 
 
 class TelemetryExporter:

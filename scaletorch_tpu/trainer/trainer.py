@@ -319,11 +319,7 @@ class Trainer:
         # env toggle remains only as the default for direct 'ring' backend
         # calls outside a Trainer.
 
-        from scaletorch_tpu.parallel.spmd import (
-            batch_specs,
-            make_spmd_train_step,
-            shard_params,
-        )
+        from scaletorch_tpu.parallel.spmd import batch_specs, shard_params
         from scaletorch_tpu.parallel.tensor_parallel import validate_tp_divisibility
 
         if cfg.tensor_parallel_size > 1:
@@ -459,30 +455,17 @@ class Trainer:
         else:
             self.tx, self.schedule = create_optimizer(cfg, include_clip=False)
 
-        self.step_fn, p_specs, o_specs = make_spmd_train_step(
-            self.mm,
-            fwd_fn,
-            self.model_cfg,
-            self.tx,
-            params_host,
-            attention_backend=self.attention_backend,
-            gradient_checkpointing=cfg.gradient_checkpointing,
-            remat_policy=cfg.remat_policy,
-            sequence_parallel=cfg.sequence_parallel,
-            max_grad_norm=cfg.max_grad_norm,
-            donate=cfg.donate_params,
-            pp_schedule=cfg.pp_engine,
-            pp_vpp=self._pp_vpp,
-            cp_layout=cfg.cp_layout,
+        # Model-family pieces the elastic remesh path needs to REBUILD the
+        # jitted step against a new mesh long after __init__'s locals are
+        # gone (cheap references, no arrays).
+        self._spmd_pieces = dict(
+            fwd_fn=fwd_fn,
             param_specs=param_specs,
             model_kwargs=model_kwargs,
             head_weight_fn=head_weight_fn,
             model_family="qwen3_moe" if is_moe else "llama",
-            nonfinite_guard=cfg.nonfinite_guard,
-            grad_allreduce_dtype=cfg.grad_allreduce_dtype,
-            grad_allreduce_axis=cfg.grad_allreduce_axis,
-            grad_allreduce_block_size=cfg.grad_allreduce_block_size,
         )
+        self.step_fn, p_specs, o_specs = self._make_step_fn(params_host)
         self.params = shard_params(self.mm, params_host, p_specs)
         self.opt_state = shard_params(self.mm, self.tx.init(params_host), o_specs)
 
@@ -556,6 +539,24 @@ class Trainer:
                 patience=cfg.straggler_patience,
                 log_frequency=cfg.log_frequency,
                 tracer=self._tracer,
+            )
+        # Elastic fleet membership (--elastic): the epoch state machine
+        # that lets survivors of a host loss agree a smaller fleet and
+        # continue from the latest checkpoint instead of tearing the run
+        # down (resilience_distributed.ElasticCoordinator; train()'s
+        # remesh-and-resume outer loop owns what a transition means).
+        self.elastic = None
+        self._elastic_fleet_hosts = jax.process_count()
+        if getattr(cfg, "elastic", False):
+            from scaletorch_tpu.resilience_distributed import (
+                ElasticCoordinator,
+            )
+
+            self.elastic = ElasticCoordinator.from_config(
+                cfg,
+                rank=jax.process_index(),
+                num_hosts=jax.process_count(),
+                exporter=self.telemetry.exporter,
             )
         self.logger.info(
             f"model={cfg.model_type} params={to_readable_format(n_params)} "
@@ -784,6 +785,7 @@ class Trainer:
         from scaletorch_tpu.resilience import TrainingDivergedError
         from scaletorch_tpu.resilience_distributed import (
             HangWatchdog,
+            PeerLostError,
             hang_timeout_from_config,
         )
 
@@ -801,81 +803,120 @@ class Trainer:
             # train() onto a foreign trainer object.
             self.telemetry.snapshotter.install(self._live_snapshot)
         profiler = self.telemetry.profiler
+        if self.elastic is not None and self.elastic.needs_join:
+            # relaunched replacement host: park at the rejoin barrier
+            # until a grow epoch admits us, then restore onto the
+            # fleet's latest checkpoint before entering lockstep
+            self._elastic_join()
         try:
-            while self.global_step < target_step:
-                self._beat("step_boundary")
-                t_boundary = time.perf_counter()
-                # telemetry drill: an injected stall here inflates the
-                # ABOUT-TO-RUN step's wall time (global_step + 1 = the
-                # step this iteration performs) so the slow-step
-                # detector fires on exactly the configured step
-                self.resilience.injector.maybe_slow_step(self.global_step + 1)
-                if profiler is not None:
-                    profiler.before_step(self.global_step + 1)
-                if self.coordinator.should_stop():
-                    self._emergency_checkpoint()
-                    self.preempted = True
-                    break
-                m = self.step()
-                step_time = time.perf_counter() - t_boundary
-                anomaly_step = self.global_step
-                m, action = self.coordinator.after_step(
-                    anomaly_step, m,
-                    rollback=lambda: self._rollback_to_last_good(anomaly_step),
-                    # positions ride the decision gather: a host-local
-                    # skip of an unreadable region must abort loudly,
-                    # not silently train on mismatched batches
-                    position=self._stream_position(),
-                    # per-host timings ride the SAME gather — the
-                    # straggler layer adds zero collectives
-                    telemetry={"step_time": step_time,
-                               "data_fetch_time": self._last_data_fetch_s},
-                )
-                if profiler is not None:
-                    profiler.after_step(anomaly_step, step_time)
-                if action == "rollback":
-                    # global_step has moved back to the restored
-                    # checkpoint; the anomalous step's metrics would be
-                    # logged against the wrong step — drop them.
-                    continue
-                last = self.metrics.log_step(
-                    self.global_step,
-                    loss=m["loss"],
-                    # optax evaluates schedule(count) BEFORE incrementing, so
-                    # the update just applied used count = global_step - 1.
-                    lr=float(self.schedule(self.global_step - 1)),
-                    grad_norm=m["grad_norm"],
-                    extras={
-                        **{k: v for k, v in m.items()
-                           if k not in ("loss", "grad_norm")},
-                        **self.resilience.counters(),
-                        **self.coordinator.straggler_counters(),
-                    },
-                )
-                if (
-                    self.cfg.eval_frequency
-                    and self.global_step % self.cfg.eval_frequency == 0
-                ):
-                    val = self.evaluate()
-                    if val is not None:
-                        self.logger.info(
-                            f"step {self.global_step:>6} | val_loss {val:.4f}"
+            # Remesh-and-resume outer loop: a PeerLostError from any
+            # epoch-bus collective means a host died or hung past the
+            # deadline — the survivors agree a shrink epoch, restore
+            # from the latest checkpoint onto the smaller topology, and
+            # re-enter the inner loop still aiming at the same absolute
+            # target_step. Non-elastic runs take one pass and the error
+            # (if any) propagates as before.
+            while True:
+                try:
+                    while self.global_step < target_step:
+                        self._beat("step_boundary")
+                        if self.elastic is not None:
+                            self.elastic.beat(self.global_step)
+                        t_boundary = time.perf_counter()
+                        # telemetry drill: an injected stall here inflates
+                        # the ABOUT-TO-RUN step's wall time (global_step +
+                        # 1 = the step this iteration performs) so the
+                        # slow-step detector fires on exactly the
+                        # configured step
+                        self.resilience.injector.maybe_slow_step(
+                            self.global_step + 1)
+                        if profiler is not None:
+                            profiler.before_step(self.global_step + 1)
+                        if self.coordinator.should_stop():
+                            self._emergency_checkpoint()
+                            self.preempted = True
+                            break
+                        m = self.step()
+                        step_time = time.perf_counter() - t_boundary
+                        anomaly_step = self.global_step
+                        m, action = self.coordinator.after_step(
+                            anomaly_step, m,
+                            rollback=lambda: self._rollback_to_last_good(
+                                anomaly_step),
+                            # positions ride the decision gather: a
+                            # host-local skip of an unreadable region must
+                            # abort loudly, not silently train on
+                            # mismatched batches
+                            position=self._stream_position(),
+                            # per-host timings ride the SAME gather — the
+                            # straggler layer adds zero collectives
+                            telemetry={
+                                "step_time": step_time,
+                                "data_fetch_time": self._last_data_fetch_s,
+                            },
                         )
-                        last = {**last, "val_loss": val}
-                if (last and self._wandb is not None
-                        and self.global_step > self._wandb_logged_step):
-                    # after a rollback the step counter regresses; wandb
-                    # rejects non-monotonic steps and would silently drop
-                    # the whole recovery region — resume logging once the
-                    # counter passes its high-water mark
-                    self._wandb.log(last, step=self.global_step)
-                    self._wandb_logged_step = self.global_step
-                if (
-                    self.cfg.save_frequency
-                    and self.cfg.checkpoint_dir
-                    and self.global_step % self.cfg.save_frequency == 0
-                ):
-                    self.save_checkpoint()
+                        if profiler is not None:
+                            profiler.after_step(anomaly_step, step_time)
+                        if action == "rollback":
+                            # global_step has moved back to the restored
+                            # checkpoint; the anomalous step's metrics
+                            # would be logged against the wrong step —
+                            # drop them.
+                            continue
+                        last = self.metrics.log_step(
+                            self.global_step,
+                            loss=m["loss"],
+                            # optax evaluates schedule(count) BEFORE
+                            # incrementing, so the update just applied
+                            # used count = global_step - 1.
+                            lr=float(self.schedule(self.global_step - 1)),
+                            grad_norm=m["grad_norm"],
+                            extras={
+                                **{k: v for k, v in m.items()
+                                   if k not in ("loss", "grad_norm")},
+                                **self.resilience.counters(),
+                                **self.coordinator.straggler_counters(),
+                            },
+                        )
+                        if (
+                            self.cfg.eval_frequency
+                            and self.global_step % self.cfg.eval_frequency
+                            == 0
+                        ):
+                            val = self.evaluate()
+                            if val is not None:
+                                self.logger.info(
+                                    f"step {self.global_step:>6} | "
+                                    f"val_loss {val:.4f}"
+                                )
+                                last = {**last, "val_loss": val}
+                        if (last and self._wandb is not None
+                                and self.global_step
+                                > self._wandb_logged_step):
+                            # after a rollback the step counter regresses;
+                            # wandb rejects non-monotonic steps and would
+                            # silently drop the whole recovery region —
+                            # resume logging once the counter passes its
+                            # high-water mark
+                            self._wandb.log(last, step=self.global_step)
+                            self._wandb_logged_step = self.global_step
+                        if (
+                            self.cfg.save_frequency
+                            and self.cfg.checkpoint_dir
+                            and self.global_step % self.cfg.save_frequency
+                            == 0
+                        ):
+                            self.save_checkpoint()
+                            # checkpoint boundary = the only scale-up
+                            # point: parked/relaunched hosts are admitted
+                            # here, where the state they must restore is
+                            # freshly on disk
+                            self._maybe_elastic_grow()
+                    break
+                except PeerLostError as exc:
+                    if self.elastic is None:
+                        raise
+                    self._elastic_recover(exc)
         except TrainingDivergedError as exc:
             # every abort path leaves a post-mortem on disk — diagnosis
             # must not depend on scrollback
@@ -1029,18 +1070,28 @@ class Trainer:
                 opt_state=self.opt_state,
                 extra={"tokens_seen": self.tokens_seen,
                        "loader_position": position,
+                       # step size in SAMPLES: lets a resume under a
+                       # different dp degree (elastic remesh) translate
+                       # the position so consumed batches stay retired
+                       "samples_per_step": getattr(
+                           self.loader, "samples_per_step", None),
                        "layer_storage": self._layer_storage()},
             )
         if saved:
             self._saved_loader_position = position
         return saved
 
-    def load_checkpoint(self, required: bool = False) -> bool:
+    def load_checkpoint(self, required: bool = False, *,
+                        target_mesh=None) -> bool:
         """Restore the newest readable checkpoint; returns whether one was
         restored. ``required`` (--resume must) raises instead of training
-        from scratch when nothing restores."""
+        from scratch when nothing restores. ``target_mesh`` reshards the
+        restore onto a DIFFERENT mesh than the live arrays' (the elastic
+        remesh path, where self.params still live on the pre-shrink
+        topology)."""
         restored = self.checkpoint_manager.load_latest(
-            params=self.params, opt_state=self.opt_state
+            params=self.params, opt_state=self.opt_state,
+            target_mesh=target_mesh,
         )
         if restored is None:
             if required:
@@ -1072,6 +1123,20 @@ class Trainer:
         # yielding from the old position — drop it so the next step()
         # re-iterates.
         position = restored["extra"].get("loader_position", self.global_step)
+        saved_spp = restored["extra"].get("samples_per_step")
+        cur_spp = getattr(self.loader, "samples_per_step", None)
+        if saved_spp and cur_spp and int(saved_spp) != int(cur_spp):
+            # the checkpoint was written under a different dp degree
+            # (elastic remesh): its position counts OLD-geometry steps —
+            # translate by sample count so every consumed batch stays
+            # retired exactly once
+            from scaletorch_tpu.data.dataloader import remap_loader_position
+
+            position = remap_loader_position(
+                position,
+                old_samples_per_step=int(saved_spp),
+                new_samples_per_step=int(cur_spp),
+            )
         self._loader_skew = position - self.global_step
         self._saved_loader_position = position
         if hasattr(self.loader, "set_state"):
@@ -1120,6 +1185,146 @@ class Trainer:
             self.loader.set_state(bad_position)
             self._train_iter = None
         return True
+
+    def _make_step_fn(self, params_template):
+        """Build (or, after an elastic remesh, REBUILD) the jitted SPMD
+        train step against the CURRENT ``self.mm``. ``params_template``
+        only needs shapes/dtypes (ShapeDtypeStructs work — opt-state
+        spec derivation goes through eval_shape), so the remesh path can
+        rebuild without materialising host params."""
+        from scaletorch_tpu.parallel.spmd import make_spmd_train_step
+
+        cfg = self.cfg
+        pieces = self._spmd_pieces
+        return make_spmd_train_step(
+            self.mm,
+            pieces["fwd_fn"],
+            self.model_cfg,
+            self.tx,
+            params_template,
+            attention_backend=self.attention_backend,
+            gradient_checkpointing=cfg.gradient_checkpointing,
+            remat_policy=cfg.remat_policy,
+            sequence_parallel=cfg.sequence_parallel,
+            max_grad_norm=cfg.max_grad_norm,
+            donate=cfg.donate_params,
+            pp_schedule=cfg.pp_engine,
+            pp_vpp=self._pp_vpp,
+            cp_layout=cfg.cp_layout,
+            param_specs=pieces["param_specs"],
+            model_kwargs=pieces["model_kwargs"],
+            head_weight_fn=pieces["head_weight_fn"],
+            model_family=pieces["model_family"],
+            nonfinite_guard=cfg.nonfinite_guard,
+            grad_allreduce_dtype=cfg.grad_allreduce_dtype,
+            grad_allreduce_axis=cfg.grad_allreduce_axis,
+            grad_allreduce_block_size=cfg.grad_allreduce_block_size,
+        )
+
+    # ---- elastic continuation (resilience_distributed.ElasticCoordinator)
+
+    def _elastic_join(self) -> None:
+        """Relaunched replacement host: block at the rejoin barrier until
+        a grow epoch admits this rank, then take the SAME restore path
+        the incumbent members take at that boundary — so the rejoiner
+        enters lockstep holding bit-identical state."""
+        view = self.elastic.join(self.global_step)
+        self._elastic_apply_view(view)
+
+    def _elastic_recover(self, exc) -> None:
+        """A collective broke (host died or hung past the deadline): run
+        the membership recovery protocol — store-based, no collectives
+        over the broken bus — and move onto the epoch it agrees."""
+        self.logger.warning(
+            f"elastic recovery at step {self.global_step}: {exc!r}"
+        )
+        view = self.elastic.on_peer_lost(self.global_step, exc=exc)
+        self._elastic_apply_view(view)
+
+    def _maybe_elastic_grow(self) -> None:
+        """Checkpoint-boundary scale-up: host 0 reads the rejoin mailbox
+        and the decision rides the epoch bus, so every member admits the
+        same joiners at the same boundary (or nobody does)."""
+        if self.elastic is None:
+            return
+        view = self.elastic.maybe_grow(self.global_step)
+        if view is not None:
+            self._elastic_apply_view(view)
+
+    def _elastic_apply_view(self, view) -> None:
+        """Move this trainer onto an adopted membership epoch: retire the
+        old epoch's checkpoint manager (its decision bus is dead or
+        renumbered), rebind the coordinator onto the new epoch's bus,
+        rebuild the topology for the agreed host count, and restore from
+        the latest checkpoint. The restore is deliberately UNIFORM —
+        members that never lost a step restore too — which keeps the
+        collective sequence identical on every host and makes the
+        post-transition trajectory a pure function of the checkpoint
+        (the bit-identical-continuation contract the elastic drills
+        pin)."""
+        if self._ckpt_mgr is not None:
+            # collective-free teardown: the old bus cannot carry the
+            # coordinated wait anymore
+            self._ckpt_mgr.detach()
+            self._ckpt_mgr = None
+        self.coordinator.rebind_bus(self.elastic.bus)
+        target_mesh = None
+        rebuild = getattr(self, "_elastic_rebuild_topology", None)
+        if callable(rebuild):
+            # real trainer: remesh + re-jit + loader geometry; toy
+            # harnesses (threaded-host drills) run without device state
+            rebuild(view)
+            target_mesh = self.mm.mesh
+        self.load_checkpoint(required=True, target_mesh=target_mesh)
+        self.elastic.pending_bootstrap = False
+
+    def _elastic_rebuild_topology(self, view) -> None:
+        """Rebuild mesh + jitted step + loader geometry for the agreed
+        host count. The dp axis absorbs the whole change
+        (parallel/mesh.elastic_mesh_kwargs); an un-shrinkable geometry
+        or a JAX runtime that has not renumbered onto the surviving
+        devices aborts loudly to the fleet-restart fallback."""
+        import math
+
+        from scaletorch_tpu.parallel.mesh import (
+            MeshShrinkError,
+            elastic_mesh_kwargs,
+        )
+        from scaletorch_tpu.parallel.spmd import batch_specs
+        from scaletorch_tpu.resilience_distributed import ElasticRemeshError
+
+        try:
+            kwargs = elastic_mesh_kwargs(
+                self.cfg.mesh_kwargs(),
+                hosts_before=self._elastic_fleet_hosts,
+                hosts_after=view.num_hosts,
+            )
+        except MeshShrinkError as exc:
+            raise ElasticRemeshError(str(exc)) from exc
+        shape = tuple(kwargs[a] for a in ("dp", "pp", "cp", "ep", "tp"))
+        if shape == self.mm.shape:
+            return  # remesh-in-place (spurious loss: everyone answered)
+        world = math.prod(shape)
+        devices = jax.devices()
+        if world != len(devices):
+            raise ElasticRemeshError(
+                f"elastic remesh to {view.num_hosts} host(s) needs "
+                f"{world} devices but the JAX runtime exposes "
+                f"{len(devices)} — the runtime did not renumber after "
+                "the membership change; falling back to a fleet restart"
+            )
+        self.mm = setup_mesh_manager(**kwargs)
+        template = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params)
+        self.step_fn, _, _ = self._make_step_fn(template)
+        self._batch_shardings = {
+            k: NamedSharding(self.mm.mesh, spec)
+            for k, spec in batch_specs().items()
+        }
+        if hasattr(self.loader, "set_data_parallel_size"):
+            self.loader.set_data_parallel_size(
+                kwargs["dp"] * self.cfg.expert_parallel_size)
+        self._train_iter = None
 
     def _emergency_checkpoint(self) -> bool:
         """Preemption-safe shutdown: synchronously persist the current

@@ -43,6 +43,32 @@ from scaletorch_tpu.resilience import retry_with_backoff
 from scaletorch_tpu.utils.logger import get_logger
 
 
+def retarget_tree(tree: Any, target_mesh: Any) -> Any:
+    """Abstract restore templates for ``tree`` on ``target_mesh``: same
+    shapes/dtypes/PartitionSpecs, shardings rebuilt on the new mesh.
+
+    Orbax restores onto whatever shardings the restore TEMPLATES carry,
+    so a cross-topology restore (elastic remesh: dp4 checkpoint onto a
+    dp2 fleet) is exactly "restore onto retargeted templates". Specs
+    survive the move because the axis NAMES are stable across epochs —
+    only the axis sizes change. Leaves without a ``NamedSharding``
+    (host numpy arrays, scalars) restore replicated."""
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    def leaf(x: Any) -> jax.ShapeDtypeStruct:
+        spec = getattr(getattr(x, "sharding", None), "spec", None)
+        if spec is None:
+            spec = PartitionSpec()
+        arr = x if hasattr(x, "shape") and hasattr(x, "dtype") \
+            else np.asarray(x)
+        return jax.ShapeDtypeStruct(
+            tuple(arr.shape), arr.dtype,
+            sharding=NamedSharding(target_mesh, spec))
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
 def _tree_spec(tree: Any) -> List[Tuple[str, Tuple[int, ...], str]]:
     """Flatten a pytree into (path, shape, dtype) rows for structural
     comparison against orbax metadata."""
@@ -398,7 +424,7 @@ class CheckpointManager:
                 )
 
     def load_latest(
-        self, params: Any, opt_state: Any
+        self, params: Any, opt_state: Any, *, target_mesh: Any = None
     ) -> Optional[Dict[str, Any]]:
         """Restore the newest readable checkpoint onto the shardings/dtypes
         of the given templates; a corrupted/partial step falls back to the
@@ -407,7 +433,16 @@ class CheckpointManager:
         With a DecisionBus the step list, each retry and each fallback
         are agreed across hosts, so every host lands on the SAME step.
         Bare multi-process runs restore the latest step with one
-        collective attempt and propagate failures."""
+        collective attempt and propagate failures.
+
+        ``target_mesh`` is the explicit cross-topology path (elastic
+        remesh): the live templates' specs are retargeted onto the given
+        mesh (``retarget_tree``) and orbax reshards the restored global
+        arrays onto the NEW topology — the checkpoint itself is
+        topology-agnostic."""
+        if target_mesh is not None:
+            params = retarget_tree(params, target_mesh)
+            opt_state = retarget_tree(opt_state, target_mesh)
         steps = sorted(self.all_steps(), reverse=True)
         if self._coordinated:
             # host 0's directory listing is authoritative — hosts racing
@@ -483,3 +518,20 @@ class CheckpointManager:
 
     def close(self) -> None:
         self._mgr.close()
+
+    def detach(self) -> None:
+        """Collective-free local teardown for elastic remesh: the bus
+        this manager coordinates over is already broken (a peer died),
+        so the coordinated ``wait()`` would wedge — drain and close
+        locally, swallowing errors; the successor manager on the new
+        epoch's bus takes over."""
+        try:
+            self._mgr.wait_until_finished()
+        except Exception as exc:
+            get_logger().warning(
+                f"detach: async drain failed (peer loss in flight): "
+                f"{exc!r}")
+        try:
+            self._mgr.close()
+        except Exception:
+            pass

@@ -21,14 +21,40 @@
 #
 # node_list.txt: one hostname/IP per line ('#' comments and blanks ignored).
 # Env overrides: SSH_USER, COORD_PORT (default 29500), LOG_DIR,
-# MAX_RESTARTS (default 0).
+# MAX_RESTARTS (default 0), ELASTIC (default 0), MEMBERSHIP_DIR.
 #
-# Exit-code contract (docs/fault_tolerance.md): a process exiting 43
-# means its hang watchdog fired on a dead collective — the job state is
-# restartable from the last checkpoint, so with MAX_RESTARTS > 0 this
-# script relaunches the whole fleet (every process must restart together:
-# the surviving processes of a wedged collective are not salvageable).
-# Exit 42 (training diverged) is NOT restarted — it needs a human.
+# Restart policy — TWO modes (docs/fault_tolerance.md "Elastic
+# operation"):
+#
+#   * Default (ELASTIC=0) — fleet-wide restart. A process exiting 43
+#     means its hang watchdog fired on a dead collective — the job state
+#     is restartable from the last checkpoint, but the surviving
+#     processes of a wedged collective are not salvageable, so with
+#     MAX_RESTARTS > 0 the WHOLE fleet is killed and relaunched
+#     together (it resumes via --resume auto).
+#
+#   * ELASTIC=1 — per-rank relaunch. The job runs with --elastic: the
+#     in-process ElasticCoordinator already remeshes the survivors
+#     around a lost host, so a crash-family exit (anything but 0/42)
+#     relaunches ONLY the dead rank. The relaunched process parks at
+#     the rejoin barrier (FileMembershipStore) and is readmitted at the
+#     fleet's next checkpoint boundary — the survivors never restart.
+#     Each rank gets MAX_RESTARTS relaunches. If the whole fleet is
+#     down at once (e.g. every rank exited 43 on an un-shrinkable
+#     geometry — the documented ElasticRemeshError fallback), the
+#     script falls back to one fleet-wide relaunch, which clears the
+#     membership directory first: stale epoch records from the previous
+#     incarnation must not outvote the fresh founding epoch.
+#
+# MEMBERSHIP_DIR should point at the job's shared
+# <checkpoint_dir>/membership directory. It is cleared (via node 0,
+# which must see the shared filesystem) on every FULL-fleet (re)launch
+# and never on a per-rank relaunch — a rejoining rank needs the live
+# epoch chain intact.
+#
+# In BOTH modes exit 42 (training diverged) is never restarted — it
+# needs a human, and re-running a diverged job just re-diverges it.
+# A diverged rank vetoes any pending restart of its peers.
 #
 # This restart loop is TRAINING-ONLY. Serving replicas share no
 # collective, so their supervision lives in
@@ -57,42 +83,131 @@ mkdir -p "$LOG_DIR"
 
 LAUNCH_TAG="st_$(date +%s)_$$"
 PIDS=()
+kill_rank() {
+    local i="$1"
+    [ -n "${PIDS[$i]:-}" ] && kill "${PIDS[$i]}" 2>/dev/null || true
+    ssh -o StrictHostKeyChecking=no -o BatchMode=yes -o ConnectTimeout=5 \
+        "$SSH_USER@${NODES[$i]}" \
+        "kill \$(cat /tmp/${LAUNCH_TAG}.pid 2>/dev/null) 2>/dev/null; rm -f /tmp/${LAUNCH_TAG}.pid" \
+        2>/dev/null || true
+}
 cleanup() {
     echo "cleaning up local ssh + remote processes..." >&2
-    for pid in "${PIDS[@]:-}"; do kill "$pid" 2>/dev/null || true; done
     # The remote trainers survive a dropped ssh connection; kill them by
     # the PID file each one wrote at startup.
-    for node in "${NODES[@]}"; do
-        ssh -o StrictHostKeyChecking=no -o BatchMode=yes -o ConnectTimeout=5 \
-            "$SSH_USER@$node" \
-            "kill \$(cat /tmp/${LAUNCH_TAG}.pid 2>/dev/null) 2>/dev/null; rm -f /tmp/${LAUNCH_TAG}.pid" \
-            2>/dev/null || true
-    done
+    for i in "${!NODES[@]}"; do kill_rank "$i"; done
 }
 trap cleanup INT TERM
 
-WATCHDOG_EXIT=43   # hang watchdog fired (resilience_distributed.py)
+WATCHDOG_EXIT=43   # hang watchdog / ElasticRemeshError (resilience_distributed.py)
 DIVERGED_EXIT=42   # training diverged — never auto-restarted
 MAX_RESTARTS="${MAX_RESTARTS:-0}"
+ELASTIC="${ELASTIC:-0}"
+MEMBERSHIP_DIR="${MEMBERSHIP_DIR:-}"
+
+clear_membership_dir() {
+    # Full-fleet (re)launch only: a fresh incarnation must found epoch 0
+    # itself, not adopt a dead fleet's epoch chain. Cleared through node
+    # 0 because the membership store lives on the job's SHARED
+    # filesystem (the control host may not mount it).
+    [ -n "$MEMBERSHIP_DIR" ] || return 0
+    echo "clearing membership dir $MEMBERSHIP_DIR (full-fleet launch)"
+    ssh -o StrictHostKeyChecking=no -o BatchMode=yes -o ConnectTimeout=5 \
+        "$SSH_USER@${NODES[0]}" "rm -rf -- '$MEMBERSHIP_DIR'" \
+        2>/dev/null || true
+}
+
+launch_rank() {
+    local i="$1" attempt="$2"
+    local node="${NODES[$i]}"
+    local log="$LOG_DIR/proc-${i}_${node}_try${attempt}.log"
+    ssh -o StrictHostKeyChecking=no -o BatchMode=yes "$SSH_USER@$node" "
+        cd '$PWD' 2>/dev/null || true
+        export JAX_COORDINATOR_ADDRESS='$COORD_ADDR'
+        export JAX_NUM_PROCESSES='$NUM_NODES'
+        export JAX_PROCESS_ID='$i'
+        echo \$\$ > /tmp/${LAUNCH_TAG}.pid
+        exec $*
+    " > "$log" 2>&1 &
+    PIDS[$i]=$!
+}
 
 launch_fleet() {
     local attempt="$1"
+    clear_membership_dir
     PIDS=()
     for i in "${!NODES[@]}"; do
-        node="${NODES[$i]}"
-        log="$LOG_DIR/proc-${i}_${node}_try${attempt}.log"
-        ssh -o StrictHostKeyChecking=no -o BatchMode=yes "$SSH_USER@$node" "
-            cd '$PWD' 2>/dev/null || true
-            export JAX_COORDINATOR_ADDRESS='$COORD_ADDR'
-            export JAX_NUM_PROCESSES='$NUM_NODES'
-            export JAX_PROCESS_ID='$i'
-            echo \$\$ > /tmp/${LAUNCH_TAG}.pid
-            exec $*
-        " > "$log" 2>&1 &
-        PIDS+=($!)
+        launch_rank "$i" "$attempt"
     done
 }
 
+# --- ELASTIC=1: per-rank supervision --------------------------------------
+if [ "$ELASTIC" = "1" ]; then
+    fleet_attempt=0
+    while :; do
+        echo "launching $NUM_NODES processes (elastic, fleet attempt $((fleet_attempt + 1))), coordinator $COORD_ADDR, logs in $LOG_DIR"
+        launch_fleet "f${fleet_attempt}"
+        declare -a TRIES DONE_RANK
+        for i in "${!NODES[@]}"; do TRIES[$i]=0; DONE_RANK[$i]=0; done
+        fleet_down=0
+        while :; do
+            running=0
+            for i in "${!NODES[@]}"; do
+                pid="${PIDS[$i]:-}"
+                [ -n "$pid" ] || continue
+                if kill -0 "$pid" 2>/dev/null; then
+                    running=$((running + 1))
+                    continue
+                fi
+                wait "$pid" && rc=0 || rc=$?
+                PIDS[$i]=""
+                if [ "$rc" -eq 0 ]; then
+                    echo "[ok]       process $i (${NODES[$i]})"
+                    DONE_RANK[$i]=1
+                elif [ "$rc" -eq "$DIVERGED_EXIT" ]; then
+                    echo "[DIVERGED] process $i (${NODES[$i]}) exited $rc — training diverged; NOT restarting (see crash report)"
+                    cleanup
+                    exit "$rc"
+                elif [ "${TRIES[$i]}" -lt "$MAX_RESTARTS" ]; then
+                    TRIES[$i]=$((TRIES[$i] + 1))
+                    echo "[ELASTIC]  process $i (${NODES[$i]}) exited $rc — relaunching ONLY this rank (${TRIES[$i]}/$MAX_RESTARTS); it will park at the rejoin barrier"
+                    launch_rank "$i" "f${fleet_attempt}r${TRIES[$i]}"
+                    running=$((running + 1))
+                else
+                    echo "[FAIL]     process $i (${NODES[$i]}) exited $rc — per-rank restart budget exhausted; see $LOG_DIR"
+                fi
+            done
+            alive_or_done=0
+            for i in "${!NODES[@]}"; do
+                { [ -n "${PIDS[$i]:-}" ] || [ "${DONE_RANK[$i]}" -eq 1 ]; } \
+                    && alive_or_done=$((alive_or_done + 1))
+            done
+            if [ "$running" -eq 0 ]; then
+                if [ "$alive_or_done" -eq "$NUM_NODES" ]; then
+                    echo "all $NUM_NODES processes finished"
+                    exit 0
+                fi
+                fleet_down=1
+                break
+            fi
+            sleep 2
+        done
+        # whole fleet down with ranks unfinished: the in-process elastic
+        # layer could not continue (e.g. every rank exited 43 on an
+        # un-shrinkable geometry) — fall back to ONE fleet-wide relaunch
+        if [ "$fleet_down" -eq 1 ] && [ "$fleet_attempt" -lt 1 ] \
+                && [ "$MAX_RESTARTS" -gt 0 ]; then
+            fleet_attempt=$((fleet_attempt + 1))
+            echo "elastic continuation impossible: restarting the fleet (membership dir cleared)"
+            cleanup
+            sleep 5
+            continue
+        fi
+        exit "$WATCHDOG_EXIT"
+    done
+fi
+
+# --- default: fleet-wide restart ------------------------------------------
 attempt=0
 while :; do
     echo "launching $NUM_NODES processes (attempt $((attempt + 1))), coordinator $COORD_ADDR, logs in $LOG_DIR"

@@ -568,6 +568,10 @@ class ToyTrainer:
         self._train_iter = None
         self._ckpt_mgr = None
         self._wandb = None
+        # no ElasticCoordinator by default: the real train() reads
+        # self.elastic to decide whether PeerLostError is recoverable
+        # (tests/test_elastic.py attaches one for the elastic drills)
+        self.elastic = None
 
     def step(self, batch=None):
         if batch is None:
@@ -596,6 +600,11 @@ def _bind_real_trainer_methods():
         "_beat", "_span", "_stream_position", "_write_crash_report",
         "_watchdog_crash_report", "_watchdog_exit", "_live_snapshot",
         "_agree_all", "_agree_any",
+        # elastic continuation (no "_elastic_rebuild_topology": its
+        # absence is exactly how the mesh-free toy skips the remesh —
+        # _elastic_apply_view getattr-guards it)
+        "_elastic_join", "_elastic_recover", "_maybe_elastic_grow",
+        "_elastic_apply_view",
     ):
         setattr(ToyTrainer, name, Trainer.__dict__[name])
     ToyTrainer.checkpoint_manager = Trainer.__dict__["checkpoint_manager"]

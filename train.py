@@ -25,7 +25,11 @@ import sys
 def main(argv=None) -> int:
     from scaletorch_tpu.config import parse_args
     from scaletorch_tpu.resilience import TrainingDivergedError
-    from scaletorch_tpu.resilience_distributed import DIVERGED_EXIT_CODE
+    from scaletorch_tpu.resilience_distributed import (
+        DIVERGED_EXIT_CODE,
+        WATCHDOG_EXIT_CODE,
+        ElasticRemeshError,
+    )
     from scaletorch_tpu.trainer.trainer import Trainer
     from scaletorch_tpu.utils.logger import get_logger
 
@@ -75,6 +79,13 @@ def main(argv=None) -> int:
         # exits 43 directly from its monitor thread)
         get_logger().error(f"training aborted: {exc}")
         return DIVERGED_EXIT_CODE
+    except ElasticRemeshError as exc:
+        # the elastic coordinator could not continue (un-shrinkable
+        # geometry, min-hosts floor, membership store unreachable):
+        # restart-family exit — the launcher's fleet-wide restart is the
+        # fallback, never a human (42 stays reserved for divergence)
+        get_logger().error(f"elastic continuation impossible: {exc}")
+        return WATCHDOG_EXIT_CODE
     except KeyboardInterrupt:
         get_logger().warning("interrupted; exiting")
         return 130
