@@ -58,6 +58,10 @@ MANIFEST: Tuple[Tuple[str, str, str], ...] = (
      "audit_entry_decode"),
     ("paged_decode_step", "scaletorch_tpu.inference.decode",
      "audit_entry_paged_decode"),
+    ("disagg_prefill_slice", "scaletorch_tpu.inference.disagg",
+     "audit_entry_prefill_slice"),
+    ("disagg_decode_slice", "scaletorch_tpu.inference.disagg",
+     "audit_entry_decode_slice"),
 )
 
 # jaxpr primitives that move bytes between mesh members. pvary /

@@ -915,6 +915,15 @@ class ServingArguments:
         metadata={"help": "In-process engine replicas behind the "
                           "prefix-aware router (scripts/serve.py)."},
     )
+    serve_disagg: str = field(
+        default="",
+        metadata={"help": "Disaggregated prefill/decode serving "
+                          "(inference/disagg.py): 'P:D' device counts "
+                          "for the prefill and decode slices, or 'auto' "
+                          "to size the split from tools/hbm_budget.json "
+                          "per-phase rows. '' = colocated (default). "
+                          "Paged cache layout only."},
+    )
     serve_slo_path: str = field(
         default="",
         metadata={"help": "SLO target file (tools/slo.json grammar, see "
@@ -957,6 +966,12 @@ class ServingArguments:
             from scaletorch_tpu.serving.admission import parse_tenant_spec
 
             parse_tenant_spec(self.serve_tenants)
+        if self.serve_disagg:
+            # same single-home delegation for the slice-split grammar
+            # (pure host parsing — no jax work at config time)
+            from scaletorch_tpu.inference.disagg import parse_disagg_spec
+
+            parse_disagg_spec(self.serve_disagg)
         if self.serve_slo_path:
             # same parse-time discipline for the SLO file: a typo'd
             # path or malformed target key fails the CLI, not /healthz

@@ -21,6 +21,11 @@ sharding specs, and attention math:
   * ``engine``    — continuous batching over a fixed-slot batch: admit
     queued requests into freed slots between decode steps (the jitted
     step never retraces), engine metrics riding the monitor plumbing.
+  * ``disagg``    — disaggregated prefill/decode serving: MPMD phase
+    slices (two meshes over disjoint device subsets, one jitted
+    program each) with page-ownership handoff between two allocators
+    through a ``PageHandoffChannel``; slice sizing from the CI-pinned
+    per-phase HBM rows.
   * ``resilience`` — serving fault tolerance: the terminal-outcome
     taxonomy (ok / timeout / shed / rejected / quarantined / aborted),
     bounded admission + load shedding, non-finite quarantine, graceful
@@ -72,4 +77,12 @@ from scaletorch_tpu.inference.engine import (  # noqa: F401
     InferenceEngine,
     Request,
     RequestResult,
+)
+from scaletorch_tpu.inference.disagg import (  # noqa: F401
+    DisaggMetrics,
+    DisaggregatedEngine,
+    HandoffError,
+    PageHandoffChannel,
+    parse_disagg_spec,
+    plan_slice_split,
 )

@@ -1421,6 +1421,23 @@ class ServingGateway:
                 "name": "warm_transfer_seconds", "type": "histogram",
                 "series": [(None, self.warm_hist)],
             })
+        # disaggregated replicas: per-request handoff latency (prefill
+        # done -> decode slot bound). In-process engines expose the
+        # histogram object directly; the per-slice GAUGES ride the
+        # generic engine_* export below (busy fractions, handoff
+        # counters — every DisaggMetrics.snapshot() key).
+        handoff_series = []
+        for rid, worker in sorted(self.workers.items()):
+            hist = getattr(
+                getattr(worker, "engine", None), "metrics", None)
+            hist = hist.hist.get("handoff") if hist is not None else None
+            if hist is not None and hist.count:
+                handoff_series.append(({"replica": rid}, hist))
+        if handoff_series:
+            families.append({
+                "name": "handoff_seconds", "type": "histogram",
+                "series": handoff_series,
+            })
         engine_samples: Dict[str, List] = {}
         for rid, worker in self.workers.items():
             for key, value in worker.gauges().items():
@@ -1484,6 +1501,29 @@ class ServingGateway:
                 "prefix_pages": snap.get("prefix_pages"),
                 "warm_pages": snap.get("warm_pages_total"),
             }
+            if "prefill_slice_devices" in snap:
+                # disaggregated replica: per-slice health (the decode
+                # slice's pool rides the base pages_in_use /
+                # page_pool_free gauges above)
+                replicas[rid]["disagg"] = {
+                    "prefill_slice": {
+                        "devices": snap.get("prefill_slice_devices"),
+                        "pages_in_use": snap.get("prefill_pages_in_use"),
+                        "pool_free": snap.get("prefill_pool_free"),
+                        "busy_fraction":
+                            snap.get("prefill_slice_busy_fraction"),
+                    },
+                    "decode_slice": {
+                        "devices": snap.get("decode_slice_devices"),
+                        "pages_in_use": snap.get("pages_in_use"),
+                        "pool_free": snap.get("page_pool_free"),
+                        "busy_fraction":
+                            snap.get("decode_slice_busy_fraction"),
+                    },
+                    "handoffs": snap.get("handoffs"),
+                    "handoff_failures": snap.get("handoff_failures"),
+                    "pages_handed_off": snap.get("pages_handed_off"),
+                }
             # process state: from the supervisor when one runs the
             # fleet, else whatever the worker itself knows (a remote
             # worker learns its child's pid from /healthz)

@@ -53,12 +53,16 @@ SCHEMA_VERSION = 1
 # chunks_dropped, attempts). ``membership`` records one elastic-fleet
 # transition per rank (resilience_distributed.ElasticCoordinator:
 # transition steady/suspect/shrink/grow/join/parked, epoch, members,
-# num_hosts, rank, lost, joined, step). Free-form kinds are allowed;
+# num_hosts, rank, lost, joined, step). ``disagg`` records the
+# disaggregated engine's per-slice state alongside each
+# ``engine_metrics`` snapshot (inference/disagg.py: slice device
+# counts, handoff counters/bytes, prefill-pool occupancy, per-slice
+# busy fractions). Free-form kinds are allowed;
 # these are the ones consumers can rely on. Adding a kind is additive —
 # v stays 1.
 KNOWN_KINDS = ("train_step", "engine_metrics", "gateway_metrics",
                "access", "latency_histograms", "supervisor", "warmup",
-               "membership")
+               "membership", "disagg")
 
 
 class TelemetryExporter:

@@ -50,6 +50,17 @@ and zero retraces (``engine_decode_compile_count == 1`` fleet-wide).
 SIGTERM must drain the whole fleet to exit 0, and the supervisor's
 JSONL event stream (spawn/ready/crash/restart) plus the ``warmup``
 record plus slo_check must hold on the artifacts.
+
+``--disagg`` (the CI ``gateway-smoke-disagg`` step) runs the single-
+process smoke against ``serve.py --disagg 4:4`` — the disaggregated
+prefill/decode engine (inference/disagg.py) on an 8-virtual-device CPU
+mesh. The parity oracle stays the COLOCATED engine (``--disagg`` is
+stripped from the oracle's args), so the assertion is the ISSUE 19
+acceptance itself: MPMD slices + page handoff add transport, never
+arithmetic. On top of the standard checks, ``/healthz`` must carry the
+per-slice ``disagg`` block, ``/metrics`` the per-slice busy-fraction
+gauges + ``handoff_seconds`` histogram, and the Chrome trace the
+``req.handoff`` lifecycle span next to the ``handoff`` tick phase.
 """
 
 from __future__ import annotations
@@ -134,7 +145,8 @@ def direct_engine_tokens() -> list:
     return engine.run()[rid].tokens
 
 
-def check_trace_correlation(trace_path: str) -> None:
+def check_trace_correlation(trace_path: str, *,
+                            disagg: bool = False) -> None:
     """Acceptance: ONE Perfetto-loadable trace in which the request's
     spans on the gateway (asyncio) thread and the engine worker thread
     are correlated by the trace id we sent, next to the tick loop's
@@ -147,6 +159,9 @@ def check_trace_correlation(trace_path: str) -> None:
     gw_names = {"gw.request", "gw.queued", "gw.stream"}
     engine_names = {"request", "req.queued", "req.prefill", "req.decode",
                     "req.finalize"}
+    if disagg:
+        # the handoff seam must be visible on the request's lifeline
+        engine_names = engine_names | {"req.handoff"}
     assert gw_names <= names, f"missing gateway spans: {gw_names - names}"
     assert engine_names <= names, \
         f"missing engine lifecycle spans: {engine_names - names}"
@@ -157,7 +172,10 @@ def check_trace_correlation(trace_path: str) -> None:
         f"{gw_tids}, engine tids {engine_tids}")
     tick_spans = {e["name"] for e in events
                   if e.get("ph") == "X" and e.get("tid") in engine_tids}
-    assert {"tick", "decode", "prefill"} <= tick_spans, (
+    want_ticks = {"tick", "decode", "prefill"}
+    if disagg:
+        want_ticks = want_ticks | {"handoff"}
+    assert want_ticks <= tick_spans, (
         f"engine tick-loop phase spans missing on the worker thread: "
         f"{tick_spans}")
     outcome = [e for e in ours
@@ -442,14 +460,18 @@ def main_mp(procs: int) -> int:
             proc.wait(timeout=30)
 
 
-def main() -> int:
+def main(disagg: bool = False) -> int:
     if os.path.isdir(TELEMETRY_DIR):
         shutil.rmtree(TELEMETRY_DIR)  # stale artifacts must not pass
     os.makedirs(TELEMETRY_DIR, exist_ok=True)
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # serve.py self-provisions the 8-virtual-device CPU mesh for
+    # --disagg; the ORACLE below deliberately stays colocated (base
+    # SERVE_ARGS), so parity is asserted across the architecture split
+    serve_args = SERVE_ARGS + (["--disagg", "4:4"] if disagg else [])
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO, "scripts", "serve.py"),
-         *SERVE_ARGS],
+         *serve_args],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env, cwd=REPO,
     )
@@ -494,19 +516,42 @@ def main() -> int:
         assert health["status"] == "ok", health
         assert health["slo"]["ok"] is True, health["slo"]
         assert health["slo"]["requests"] == 1, health["slo"]
+        if disagg:
+            # per-slice state must be live on /healthz
+            dis = health["replicas"]["r0"].get("disagg")
+            assert dis is not None, health["replicas"]
+            assert dis["prefill_slice"]["devices"] == 4, dis
+            assert dis["decode_slice"]["devices"] == 4, dis
+            assert dis["handoffs"] >= 1, dis
+            assert dis["handoff_failures"] == 0, dis
+            assert dis["pages_handed_off"] >= 1, dis
+            print(f"[smoke] /healthz disagg block OK "
+                  f"({dis['handoffs']:g} handoffs, "
+                  f"{dis['pages_handed_off']:g} pages)")
         metrics = urllib.request.urlopen(
             f"{base}/metrics", timeout=30).read().decode()
         assert "scaletorch_http_requests_received 1.0" in metrics, \
             metrics[:400]
         # tenant-labeled histogram series (labels sort le < tenant)
-        for needle in (
+        needles = [
             "# TYPE scaletorch_request_ttft_seconds histogram",
             'scaletorch_request_ttft_seconds_count{tenant="default"} 1',
             "scaletorch_request_tpot_seconds_bucket{le=",
             'scaletorch_request_queue_wait_seconds_count'
             '{tenant="default"} 1',
             'scaletorch_engine_pages_in_use{replica="r0"}',
-        ):
+        ]
+        if disagg:
+            needles += [
+                'scaletorch_engine_prefill_slice_busy_fraction'
+                '{replica="r0"}',
+                'scaletorch_engine_decode_slice_busy_fraction'
+                '{replica="r0"}',
+                'scaletorch_engine_pages_handed_off{replica="r0"}',
+                "# TYPE scaletorch_handoff_seconds histogram",
+                'scaletorch_handoff_seconds_count{replica="r0"} 1',
+            ]
+        for needle in needles:
             assert needle in metrics, f"missing {needle}"
         prom_path = os.path.join(TELEMETRY_DIR, "metrics_scrape.txt")
         with open(prom_path, "w") as f:
@@ -519,7 +564,8 @@ def main() -> int:
         print("[smoke] SIGTERM drain exit 0 OK")
 
         check_trace_correlation(
-            os.path.join(TELEMETRY_DIR, "serve.trace.json"))
+            os.path.join(TELEMETRY_DIR, "serve.trace.json"),
+            disagg=disagg)
         events_path = os.path.join(TELEMETRY_DIR, "gateway_events.jsonl")
         check_access_log(events_path)
         run_slo_check(events_path, prom_path)
@@ -536,5 +582,13 @@ if __name__ == "__main__":
                     help="N >= 2: run the process-fleet crash drill "
                          "(serve.py --serve_replica_procs N) instead of "
                          "the single-process smoke.")
+    ap.add_argument("--disagg", action="store_true",
+                    help="Run the single-process smoke against "
+                         "serve.py --disagg 4:4 (disaggregated prefill/"
+                         "decode slices); the parity oracle stays "
+                         "colocated.")
     cli = ap.parse_args()
-    sys.exit(main_mp(cli.procs) if cli.procs > 0 else main())
+    if cli.procs > 0 and cli.disagg:
+        ap.error("--disagg is in-process only (no --procs)")
+    sys.exit(main_mp(cli.procs) if cli.procs > 0
+             else main(disagg=cli.disagg))

@@ -57,6 +57,14 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--cache_layout", default="paged",
                    choices=("dense", "paged"))
     p.add_argument("--page_size", type=int, default=16)
+    p.add_argument("--disagg", default="",
+                   help="Disaggregated prefill/decode serving "
+                        "(inference/disagg.py): 'P:D' splits the "
+                        "visible devices into a P-device prefill slice "
+                        "and a D-device decode slice; 'auto' sizes the "
+                        "split from tools/hbm_budget.json's per-phase "
+                        "rows. Paged layout only; in-process replicas "
+                        "only (not --serve_replica_procs).")
     p.add_argument("--serve_host", default="127.0.0.1")
     p.add_argument("--serve_port", type=int, default=8000)
     p.add_argument("--serve_replicas", type=int, default=1)
@@ -147,10 +155,13 @@ def build_model(args):
 
 
 def build_engine(args, cfg, params, tracer=None):
-    from scaletorch_tpu.inference import InferenceEngine, SamplingParams
+    from scaletorch_tpu.inference import (
+        DisaggregatedEngine,
+        InferenceEngine,
+        SamplingParams,
+    )
 
-    return InferenceEngine(
-        params, cfg,
+    kw = dict(
         max_slots=args.max_slots, max_seq=args.max_seq,
         prefill_len=args.prefill_len,
         sampling=SamplingParams(temperature=0.0),
@@ -158,6 +169,13 @@ def build_engine(args, cfg, params, tracer=None):
         strict_submit=False,
         tracer=tracer,
     )
+    if getattr(args, "disagg", ""):
+        from scaletorch_tpu.inference.disagg import parse_disagg_spec
+
+        return DisaggregatedEngine(
+            params, cfg, disagg_split=parse_disagg_spec(args.disagg),
+            **kw)
+    return InferenceEngine(params, cfg, **kw)
 
 
 def make_replica_spawner(args):
@@ -355,8 +373,32 @@ async def _main(args) -> int:
     return 0
 
 
+def _configure_disagg_devices(args) -> None:
+    """--disagg needs a multi-device platform; on the CPU simulation
+    path that is the host-platform device-count XLA flag, which must be
+    set BEFORE the first jax import (all jax imports here are lazy —
+    the first happens inside build_model). A caller that already
+    imported jax configured its own devices; respect that."""
+    if not args.disagg or "jax" in sys.modules:
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+
+
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.disagg:
+        if args.cache_layout != "paged":
+            raise SystemExit(
+                "--disagg requires --cache_layout paged (the page is "
+                "the handoff unit)")
+        if args.serve_replica_procs > 0:
+            raise SystemExit(
+                "--disagg runs in-process replicas only; drop "
+                "--serve_replica_procs")
+        _configure_disagg_devices(args)
     return asyncio.run(_main(args))
 
 

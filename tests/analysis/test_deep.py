@@ -40,8 +40,9 @@ class TestManifestAuditsClean:
         assert set(reports) == {
             "spmd_train_step", "declarative_train_step",
             "prefill_step", "decode_step", "paged_decode_step",
+            "disagg_prefill_slice", "disagg_decode_slice",
         }
-        assert len(MANIFEST) == 5
+        assert len(MANIFEST) == 7
 
     def test_entries_filter_skips_unselected_builders(self):
         """A scoped run builds ONLY the selected entries (an unrelated

@@ -40,6 +40,7 @@ class TestManifestMemoryClean:
         assert set(reports) == {
             "spmd_train_step", "declarative_train_step",
             "prefill_step", "decode_step", "paged_decode_step",
+            "disagg_prefill_slice", "disagg_decode_slice",
         }
 
     def test_xla_accounting_available_on_cpu(self, full_memory_audit):
