@@ -21,7 +21,11 @@ budget gate (ST8xx — ``budget.py`` against ``tools/comm_budget.json``).
 accounting (ST10xx — ``memory.py`` against ``tools/hbm_budget.json``);
 ``--tier deep,memory`` runs both off one compile per entry.
 ``--tier concurrency`` runs only the ST9xx family (also part of the
-default ast tier).
+default ast tier). ``--tier ownership`` runs the ST11xx
+resource-conservation tier (``ownership.py`` — acquire/release
+lifecycle, terminal-outcome funnels, span balance, rollback ordering);
+it is pure-AST like the default tier but opt-in, so the default run
+stays fast.
 
 ``--select`` accepts pass names or code families, case-insensitively:
 ``--select ST9`` (or ``st901``) runs the concurrency family.
@@ -38,6 +42,7 @@ from typing import List, Optional, Sequence, Set
 from . import (
     concurrency,
     donation,
+    ownership,
     prng,
     retrace,
     sharding,
@@ -80,9 +85,16 @@ FAMILIES = {
 }
 CONCURRENCY_PASSES = FAMILIES["ST9"]
 
+# tier-only AST passes: run when their tier (or pass name) is selected,
+# never as part of the default `--tier ast` sweep
+TIER_ONLY_PASSES = {
+    "ownership": ownership.run,
+}
+OWNERSHIP_PASSES = ("ownership",)
+
 __all__ = [
     "Finding", "SourceModule", "ProjectIndex", "PASSES", "FAMILIES",
-    "CONCURRENCY_PASSES",
+    "CONCURRENCY_PASSES", "TIER_ONLY_PASSES", "OWNERSHIP_PASSES",
     "collect_files", "load_baseline", "save_baseline", "split_by_baseline",
     "analyze", "analyze_paths", "resolve_select",
 ]
@@ -97,6 +109,7 @@ def resolve_select(select: Sequence[str]) -> List[str]:
     a silently-green empty run."""
     wanted: List[str] = []
     valid_passes = {p.lower(): p for p in PASSES}
+    valid_passes.update({p.lower(): p for p in TIER_ONLY_PASSES})
     for token in select:
         t = token.strip()
         if not t:
@@ -118,6 +131,16 @@ def resolve_select(select: Sequence[str]) -> List[str]:
                 "static HBM audit); run with --tier memory instead of "
                 "--select"
             )
+        # ST11 / ST11xx is the ownership tier — same precedent: the tier
+        # flag is the supported spelling (the family maps 1:1 to it).
+        if low.startswith("st11") and (
+            len(low) == 4 or (len(low) == 6 and low[4:].isdigit())
+        ):
+            raise ValueError(
+                f"selector {token!r} is the ownership-tier family "
+                "(ST11xx resource lifecycle); run with --tier ownership "
+                "instead of --select"
+            )
         fam = None
         # a family is exactly "STn" or a full code "STnxx" — trailing
         # garbage ("ST9q") must NOT silently match a family
@@ -137,12 +160,13 @@ def resolve_select(select: Sequence[str]) -> List[str]:
             continue
         raise ValueError(
             f"unknown pass or family {token!r}; valid passes: "
-            f"{', '.join(sorted(PASSES))}; valid families: "
+            f"{', '.join(sorted(valid_passes.values()))}; valid families: "
             f"{', '.join(sorted(FAMILIES))}"
         )
     if not wanted:
         raise ValueError(
-            f"empty selection; valid passes: {', '.join(sorted(PASSES))}; "
+            f"empty selection; valid passes: "
+            f"{', '.join(sorted(set(PASSES) | set(TIER_ONLY_PASSES)))}; "
             f"valid families: {', '.join(sorted(FAMILIES))}"
         )
     return wanted
@@ -157,7 +181,7 @@ def analyze(
     index = ProjectIndex(modules)
     findings: List[Finding] = []
     wanted = set(resolve_select(select)) if select else set(PASSES)
-    for name, pass_fn in PASSES.items():
+    for name, pass_fn in {**PASSES, **TIER_ONLY_PASSES}.items():
         if name not in wanted:
             continue
         if name == "sharding":

@@ -11,6 +11,11 @@ single compile of the entry-point manifest):
   race & deadlock detection plus the telemetry kind registry); the
   focused invocation is ``python -m scaletorch_tpu.analysis --select
   ST9 <paths>`` and this tier is its spelled-out twin for CI.
+* ``--tier ownership`` — the ST11xx resource-conservation tier
+  (acquire/release lifecycle over the CONTRACT table in
+  analysis/ownership.py, terminal-outcome funnels, span balance,
+  rollback ordering). Pure-AST, no jax; composes with the others
+  (``--tier ast,concurrency,ownership`` is one process, one parse).
 * ``--tier deep`` — additionally traces and compiles the registered
   entry-point manifest on virtual CPU meshes (jaxpr/HLO audit, ST7xx)
   and checks the per-entry comm budget (``tools/comm_budget.json``,
@@ -41,6 +46,7 @@ from pathlib import Path
 from . import (
     CONCURRENCY_PASSES,
     FAMILIES,
+    OWNERSHIP_PASSES,
     PASSES,
     analyze_paths,
     load_baseline,
@@ -50,6 +56,37 @@ from . import (
 )
 
 DEFAULT_BASELINE = Path("tools") / "jaxlint_baseline.json"
+
+
+def _render_sarif(findings) -> str:
+    """SARIF 2.1.0, byte-stable: sorted keys, fixed indent, and nothing
+    run-dependent (no timestamps, no absolute paths) — the same tree
+    always serializes to the same bytes, so the uploaded scan diffs
+    clean between identical runs."""
+    rules = sorted({f.code for f in findings})
+    doc = {
+        "$schema": "https://raw.githubusercontent.com/oasis-tcs/"
+                   "sarif-spec/master/Schemata/sarif-schema-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "jaxlint",
+                "informationUri":
+                    "https://github.com/jianzhnie/ScaleTorch",
+                "rules": [{"id": code} for code in rules],
+            }},
+            "results": [{
+                "ruleId": f.code,
+                "level": "error" if f.severity == "error" else "warning",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.file},
+                    "region": {"startLine": max(1, f.line)},
+                }}],
+            } for f in findings],
+        }],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
 
 
 def _render_github(f) -> str:
@@ -88,7 +125,9 @@ def main(argv=None) -> int:
         "--tier", default="ast", metavar="TIER[,TIER...]",
         help="comma list of: 'ast' = pure-AST passes only (no jax); "
              "'concurrency' = only the ST9xx thread-race/deadlock "
-             "family; 'deep' also runs the jaxpr/HLO entry-point audit "
+             "family; 'ownership' = the ST11xx resource-lifecycle tier "
+             "(pure-AST, composes: --tier ast,concurrency,ownership); "
+             "'deep' also runs the jaxpr/HLO entry-point audit "
              "and the comm-budget gate; 'memory' runs the static HBM "
              "audit and the hbm-budget gate over the same compiled "
              "manifest (e.g. --tier deep,memory compiles each entry "
@@ -147,13 +186,16 @@ def main(argv=None) -> int:
         help="memory tier: skip the hbm-budget comparison",
     )
     parser.add_argument(
-        "--format", choices=("text", "json", "github"), default="text",
+        "--format", choices=("text", "json", "github", "sarif"),
+        default="text",
         help="'github' emits GitHub Actions ::error/::warning "
-             "annotations so findings render inline on PRs",
+             "annotations so findings render inline on PRs; 'sarif' "
+             "emits a byte-stable SARIF 2.1.0 document for GitHub "
+             "code scanning upload",
     )
     args = parser.parse_args(argv)
 
-    known_tiers = ("ast", "concurrency", "deep", "memory")
+    known_tiers = ("ast", "concurrency", "ownership", "deep", "memory")
     tiers = [t.strip() for t in args.tier.split(",") if t.strip()]
     unknown = sorted(set(tiers) - set(known_tiers))
     if unknown or not tiers:
@@ -194,24 +236,38 @@ def main(argv=None) -> int:
 
     select = [s.strip() for s in args.select.split(",") if s.strip()] \
         if args.select else None
-    if "concurrency" in tiers and "ast" not in tiers:
+    # The pass pool the AST-tier part of this run draws from. `ast`
+    # means every default pass; `concurrency`/`ownership` add (or, with
+    # no `ast`, restrict to) their families.
+    ast_pool: list = []
+    if "ast" in tiers:
+        ast_pool.extend(PASSES)
+    if "concurrency" in tiers:
+        ast_pool.extend(p for p in CONCURRENCY_PASSES if p not in ast_pool)
+    if "ownership" in tiers:
+        ast_pool.extend(p for p in OWNERSHIP_PASSES if p not in ast_pool)
+    narrow = [t for t in ("concurrency", "ownership") if t in tiers]
+    if narrow and "ast" not in tiers:
         # the tier IS a selection; an explicit --select narrows within it
         try:
-            wanted = resolve_select(select) if select else \
-                list(CONCURRENCY_PASSES)
+            wanted = resolve_select(select) if select else list(ast_pool)
         except ValueError as e:
             print(f"error: {e}", file=sys.stderr)
             return 2
-        narrowed = [p for p in wanted if p in CONCURRENCY_PASSES]
+        narrowed = [p for p in wanted if p in ast_pool]
         if not narrowed:
             print(
                 f"error: --select {args.select!r} selects nothing inside "
-                f"--tier concurrency (its passes: "
-                f"{', '.join(CONCURRENCY_PASSES)})",
+                f"--tier {','.join(narrow)} (its passes: "
+                f"{', '.join(ast_pool)})",
                 file=sys.stderr,
             )
             return 2
         select = narrowed
+    elif "ownership" in tiers and select is None:
+        # ast,...,ownership with no --select: run the default passes
+        # PLUS the opt-in ownership pass in the one process
+        select = ast_pool
     extra_axes = {s.strip() for s in args.extra_axes.split(",") if s.strip()}
     try:
         findings, errors = analyze_paths(
@@ -348,13 +404,15 @@ def main(argv=None) -> int:
         print(json.dumps(
             [f.__dict__ for f in findings], indent=2
         ))
+    elif args.format == "sarif":
+        print(_render_sarif(findings))
     elif args.format == "github":
         for f in findings:
             print(_render_github(f))
     else:
         for f in findings:
             print(f.render())
-    if args.format != "json":
+    if args.format not in ("json", "sarif"):
         n_err = sum(1 for f in findings if f.severity == "error")
         n_warn = len(findings) - n_err
         tail = f" ({suppressed_count} baselined)" if suppressed_count else ""

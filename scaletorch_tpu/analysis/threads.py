@@ -364,6 +364,7 @@ class ThreadModel:
         # call-site registries run AFTER every module's types are known:
         # the typed-receiver guard in _bind_callsite_args and the
         # queue-attr check both read attr_types across modules
+        self._index_call_sites()
         for cls, mname, fn, params, param_attr in self._pending_bindings:
             self._bind_callsite_args(cls, mname, fn, params, param_attr)
         for ms in self.index.scopes.values():
@@ -428,54 +429,67 @@ class ThreadModel:
             self._pending_bindings.append(
                 (cls, mname, fn, params, param_attr))
 
+    def _index_call_sites(self) -> None:
+        """One walk over every tree, bucketing calls by attribute name
+        and by constructor tail. ``_bind_callsite_args`` used to rescan
+        every module per pending binding — the dominant cost of the
+        whole analyzer on this repo; the buckets make each binding a
+        dictionary lookup over only the calls that can match."""
+        self._calls_by_attr: Dict[str, List[tuple]] = {}
+        self._calls_by_ctor: Dict[str, List[tuple]] = {}
+        for ms2 in self.index.scopes.values():
+            for call in ast.walk(ms2.sm.tree):
+                if not isinstance(call, ast.Call):
+                    continue
+                if isinstance(call.func, ast.Attribute):
+                    self._calls_by_attr.setdefault(
+                        call.func.attr, []).append((ms2, call))
+                d = dotted_name(call.func)
+                if d is not None:
+                    self._calls_by_ctor.setdefault(
+                        d.split(".")[-1], []).append((ms2, call))
+
     def _bind_callsite_args(self, cls: str, mname: str, fn: ast.AST,
                             params: List[str],
                             param_attr: Dict[str, str]) -> None:
         """Find calls of ``cls.mname`` (typed ``recv.m(...)`` or the
         constructor ``C(...)``) and record function-valued args."""
-        for ms2 in self.index.scopes.values():
-            for call in ast.walk(ms2.sm.tree):
-                if not isinstance(call, ast.Call):
+        if mname == "__init__":
+            sites = self._calls_by_ctor.get(cls, ())
+        else:
+            sites = self._calls_by_attr.get(mname, ())
+        for ms2, call in sites:
+            caller_cls = None
+            if mname != "__init__":
+                # attribute call of this method name. When the
+                # receiver's class is statically known it must BE
+                # `cls` — binding a callback into a same-named
+                # method of a different class fabricates roots and
+                # false races. Unknown receivers stay bound (the
+                # over-approximation recall needs), bounded by the
+                # param-name match.
+                caller_cls = self._enclosing_class(ms2, call)
+                rtype = self._recv_type(call.func.value, caller_cls, {})
+                if rtype is not None and rtype != cls:
                     continue
-                matched = False
-                caller_cls = None
-                if mname == "__init__":
-                    d = dotted_name(call.func)
-                    if d is not None and d.split(".")[-1] == cls:
-                        matched = True
-                elif isinstance(call.func, ast.Attribute) and \
-                        call.func.attr == mname:
-                    # attribute call of this method name. When the
-                    # receiver's class is statically known it must BE
-                    # `cls` — binding a callback into a same-named
-                    # method of a different class fabricates roots and
-                    # false races. Unknown receivers stay bound (the
-                    # over-approximation recall needs), bounded by the
-                    # param-name match.
-                    caller_cls = self._enclosing_class(ms2, call)
-                    rtype = self._recv_type(call.func.value, caller_cls, {})
-                    if rtype is None or rtype == cls:
-                        matched = True
-                if not matched:
-                    continue
-                if caller_cls is None:
-                    caller_cls = self._enclosing_class(ms2, call)
-                offset = 1  # skip self
-                for i, arg in enumerate(call.args):
-                    idx = i + offset
-                    if idx < len(params) and params[idx] in param_attr:
-                        ref = self._func_ref(ms2, arg, caller_cls)
-                        if ref is not None:
-                            self.cb_by_class_attr.setdefault(
-                                (cls, param_attr[params[idx]]), set()
-                            ).add(ref)
-                for kw in call.keywords:
-                    if kw.arg in param_attr:
-                        ref = self._func_ref(ms2, kw.value, caller_cls)
-                        if ref is not None:
-                            self.cb_by_class_attr.setdefault(
-                                (cls, param_attr[kw.arg]), set()
-                            ).add(ref)
+            if caller_cls is None:
+                caller_cls = self._enclosing_class(ms2, call)
+            offset = 1  # skip self
+            for i, arg in enumerate(call.args):
+                idx = i + offset
+                if idx < len(params) and params[idx] in param_attr:
+                    ref = self._func_ref(ms2, arg, caller_cls)
+                    if ref is not None:
+                        self.cb_by_class_attr.setdefault(
+                            (cls, param_attr[params[idx]]), set()
+                        ).add(ref)
+            for kw in call.keywords:
+                if kw.arg in param_attr:
+                    ref = self._func_ref(ms2, kw.value, caller_cls)
+                    if ref is not None:
+                        self.cb_by_class_attr.setdefault(
+                            (cls, param_attr[kw.arg]), set()
+                        ).add(ref)
 
     def _collect_call_registries(self, ms: ModuleScopes) -> None:
         for call in ast.walk(ms.sm.tree):

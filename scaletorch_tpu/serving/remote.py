@@ -1154,3 +1154,6 @@ class RemoteEngineWorker:
         """Tear down the poller (supervisor replacement path)."""
         self._stop.set()
         self._probe.set()
+        # ident is None until start(): join() before then raises
+        if self._poller.ident is not None:
+            self._poller.join(timeout=5.0)

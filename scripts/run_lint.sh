@@ -4,31 +4,70 @@
 #
 #   bash scripts/run_lint.sh
 #
-# Three checks:
-#   1. jaxlint  — python -m scaletorch_tpu.analysis over the package and
-#      tools/, gated on tools/jaxlint_baseline.json (new findings fail).
-#      The default ast tier includes the ST9xx concurrency family.
+# Four checks:
+#   1. jaxlint  — python -m scaletorch_tpu.analysis over the package,
+#      tools/ and scripts/, gated on tools/jaxlint_baseline.json (new
+#      findings fail). The default ast tier includes the ST9xx
+#      concurrency family.
 #   2. jaxlint --tier concurrency — the ST9xx thread-race/deadlock
 #      family spelled out on its own, so a red concurrency finding is
 #      unmissable in the log (focused local run: --select ST9).
-#   3. ruff     — pycodestyle/pyflakes/isort per [tool.ruff] in
+#   3. jaxlint --tier ownership — the ST11xx resource-conservation
+#      tier: page/handle/thread lifecycle, terminal-outcome funnels,
+#      span balance, rollback ordering.
+#   4. ruff     — pycodestyle/pyflakes/isort per [tool.ruff] in
 #      pyproject.toml. Skipped with a warning when ruff isn't installed
 #      (the TPU dev containers don't ship it; CI installs it).
+#
+# Each jaxlint tier prints its wall time, and the combined
+# ast+concurrency+ownership time is held under LINT_BUDGET_S (default
+# 120s) — a regression in analyzer cost fails the gate loudly instead
+# of silently eating CI minutes.
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
+LINT_BUDGET_S="${LINT_BUDGET_S:-120}"
+LINT_PATHS=(scaletorch_tpu/ tools/ scripts/)
+
 rc=0
+combined=0
 
-echo "== jaxlint (python -m scaletorch_tpu.analysis) =="
-JAX_PLATFORMS=cpu python -m scaletorch_tpu.analysis scaletorch_tpu/ tools/ || rc=1
+now() { date +%s.%N; }
 
-echo "== jaxlint concurrency tier (ST9xx races & deadlocks) =="
+elapsed() { # elapsed <t0> <t1> -> prints seconds with 1 decimal
+    awk -v a="$1" -v b="$2" 'BEGIN{printf "%.1f", b - a}'
+}
+
 # Under GitHub Actions the findings render as inline PR annotations;
 # locally they print as plain file:line diagnostics.
 fmt=text
 [ -n "${GITHUB_ACTIONS:-}" ] && fmt=github
-JAX_PLATFORMS=cpu python -m scaletorch_tpu.analysis --tier concurrency \
-    --format "$fmt" scaletorch_tpu/ tools/ || rc=1
+
+run_tier() { # run_tier <label> <jaxlint args...>
+    local label="$1"; shift
+    echo "== jaxlint $label =="
+    local t0 t1 dt
+    t0=$(now)
+    JAX_PLATFORMS=cpu python -m scaletorch_tpu.analysis "$@" \
+        "${LINT_PATHS[@]}" || rc=1
+    t1=$(now)
+    dt=$(elapsed "$t0" "$t1")
+    echo "-- tier wall time [$label]: ${dt}s"
+    combined=$(awk -v a="$combined" -v b="$dt" 'BEGIN{printf "%.1f", a + b}')
+}
+
+run_tier "ast (default tier, incl. ST9xx)"
+run_tier "concurrency tier (ST9xx races & deadlocks)" \
+    --tier concurrency --format "$fmt"
+run_tier "ownership tier (ST11xx lifecycle & conservation)" \
+    --tier ownership --format "$fmt"
+
+echo "== jaxlint combined wall time: ${combined}s (budget ${LINT_BUDGET_S}s) =="
+if awk -v c="$combined" -v b="$LINT_BUDGET_S" 'BEGIN{exit !(c > b)}'; then
+    echo "jaxlint tiers exceeded the ${LINT_BUDGET_S}s budget" \
+         "(set LINT_BUDGET_S to override)"
+    rc=1
+fi
 
 echo "== ruff check =="
 if command -v ruff >/dev/null 2>&1; then

@@ -1,0 +1,157 @@
+"""Seeded ST11xx ownership violations (parsed, never imported).
+
+Each method carries exactly the bug its comment names; line numbers are
+anchored by tests/analysis/test_ownership.py.
+"""
+
+import socket
+import threading
+
+
+class PageAllocator:
+    """Stub with the contract method names (the real one lives in
+    scaletorch_tpu/inference/kv_cache.py)."""
+
+    def alloc(self, n):
+        return list(range(n))
+
+    def retain(self, p):
+        pass
+
+    def release(self, p):
+        pass
+
+
+class Metrics:
+    def record_outcome(self, outcome):
+        pass
+
+
+class LeakyEngine:
+    def __init__(self):
+        self.allocator = PageAllocator()
+        self._slot_pages = {}
+
+    def leak_on_early_return(self, n):
+        pages = self.allocator.alloc(n)  # ST1101: leaks on the early return
+        if pages is None:
+            return None
+        if n > 4:
+            return "too big"
+        for p in pages:
+            self.allocator.release(p)
+        return "ok"
+
+    def double_release(self, n):
+        pages = self.allocator.alloc(n)
+        if pages is None:
+            return
+        for p in pages:
+            self.allocator.release(p)
+        for p in pages:
+            self.allocator.release(p)  # ST1102: second release, same path
+
+    def admit(self, i, n):
+        pages = self.allocator.alloc(n)
+        if pages is None:
+            return False
+        self._slot_pages[i] = pages  # owning-container store (discharges)
+        return True
+
+    def retire_without_release(self, i):
+        self._slot_pages[i] = []  # ST1101: cleared with no release loop
+
+    def retire_ok(self, i):
+        for p in self._slot_pages[i]:
+            self.allocator.release(p)
+        self._slot_pages[i] = []
+
+
+def append_marker(path, line):
+    f = open(path, "a")  # ST1101: never closed, not returned
+    f.write(line)
+    return True
+
+
+def probe(host, port):
+    s = socket.create_connection((host, port))  # ST1101: never closed
+    s.sendall(b"ping")
+    return True
+
+
+def run_worker(fn):
+    t = threading.Thread(target=fn)
+    t.start()  # ST1101: local thread, never joined or stored
+    return True
+
+
+class Poller:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        pass
+
+    def start(self):
+        self._thread.start()  # ST1101: no method of Poller ever joins it
+
+    def stop(self):
+        pass
+
+
+class Outcomes:
+    def __init__(self):
+        self.metrics = Metrics()
+        self._results = {}
+
+    def _finalize(self, rid, outcome):
+        self._results[rid] = outcome
+        self.metrics.record_outcome(outcome)
+
+    def shortcut(self, rid):
+        self._results[rid] = "done"  # ST1103: terminal store off-funnel
+        self.metrics.record_outcome("done")  # ST1103: terminal call off-funnel
+
+
+class Traced:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def begin_only(self, tid):
+        self.tracer.async_event("b", "fx.work", tid)  # ST1104: never ended
+
+    def end_only(self, tid):
+        self.tracer.async_event("e", "fx.gone", tid)  # ST1104: never begun
+
+    def balanced(self, tid):
+        self.tracer.async_event("b", "fx.ok", tid)
+        self.tracer.async_event("e", "fx.ok", tid)
+
+    def instant_closed(self, tid):
+        self.tracer.async_event("b", "fx.fast", tid)
+        self.tracer.async_event("n", "fx.fast", tid)
+
+
+class Handoff:
+    def __init__(self):
+        self.allocator = PageAllocator()
+        self.src_allocator = PageAllocator()
+        self.slots = {}
+
+    def copy(self, src, dst):
+        pass
+
+    def transfer(self, h, n):
+        pages = self.allocator.alloc(n)
+        if pages is None:
+            return False
+        try:
+            self.copy(h.pages, pages)
+        except RuntimeError:
+            for p in h.pages:  # ST1105: source released before destination
+                self.src_allocator.release(p)
+            for p in pages:
+                self.allocator.release(p)
+            return False
+        self.slots[h.rid] = pages
+        return True

@@ -1,0 +1,158 @@
+"""Idiomatic ownership shapes the ST11xx tier must stay quiet on
+(parsed, never imported) — the zero-false-positive bar."""
+
+import socket
+import threading
+
+
+class PageAllocator:
+    def alloc(self, n):
+        return list(range(n))
+
+    def retain(self, p):
+        pass
+
+    def release(self, p):
+        pass
+
+
+class Metrics:
+    def record_outcome(self, outcome):
+        pass
+
+
+class Engine:
+    def __init__(self):
+        self.allocator = PageAllocator()
+        self._slot_pages = {}
+        self._results = {}
+        self.metrics = Metrics()
+
+    def reserve(self, req, shared_pages):
+        """Owned-returning: retain-loop + maybe-None alloc + rollback,
+        ownership escapes through the return (the _reserve_pages shape)."""
+        for p in shared_pages:
+            self.allocator.retain(p)
+        own = self.allocator.alloc(req)
+        if own is None:
+            for p in shared_pages:
+                self.allocator.release(p)
+            return None
+        return shared_pages + own
+
+    def admit(self, i, req):
+        reserved = self.reserve(req, [])
+        if reserved is None:
+            return False
+        self._slot_pages[i] = reserved
+        return True
+
+    def retire(self, i):
+        for p in self._slot_pages[i]:
+            self.allocator.release(p)
+        self._slot_pages[i] = []
+
+    def export_pages(self, valid):
+        """Retain under try/finally — post-release reads stay legal."""
+        for p in valid:
+            self.allocator.retain(p)
+        try:
+            payload = list(valid)
+        finally:
+            for p in valid:
+                self.allocator.release(p)
+        return payload, len(valid)
+
+    def _finalize(self, rid, outcome):
+        self._results[rid] = outcome
+        self.metrics.record_outcome(outcome)
+
+    def finish(self, rid):
+        self._finalize(rid, "ok")
+
+
+def read_config(path):
+    with open(path) as f:
+        return f.read()
+
+
+def head_line_ok(path):
+    f = open(path)
+    try:
+        return f.readline()
+    finally:
+        f.close()
+
+
+def probe_ok(host, port):
+    s = socket.create_connection((host, port))
+    try:
+        s.sendall(b"ping")
+    finally:
+        s.close()
+    return True
+
+
+def fire_and_forget(fn):
+    # daemon=True declares the thread unjoinable by design
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+
+
+class Poller:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._stop = threading.Event()
+
+    def _loop(self):
+        pass
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+class Traced:
+    def __init__(self, tracer):
+        self.tracer = tracer
+
+    def _req_event(self, ph, tid, name):
+        self.tracer.async_event(ph, name, tid)
+
+    def work(self, tid, admitted):
+        self._req_event("b", tid, "fx.step")
+        self._req_event("n", tid, "fx.note")
+        self._req_event(
+            "e", tid, "fx.step" if admitted else "fx.other")
+
+    def other(self, tid):
+        self._req_event("b", tid, "fx.other")
+
+
+class Handoff:
+    def __init__(self):
+        self.allocator = PageAllocator()
+        self.src_allocator = PageAllocator()
+        self.slots = {}
+
+    def copy(self, src, dst):
+        pass
+
+    def transfer(self, h, n):
+        """Destination-before-source rollback — the PR 19 discipline."""
+        pages = self.allocator.alloc(n)
+        if pages is None:
+            return False
+        try:
+            self.copy(h.pages, pages)
+        except RuntimeError:
+            for p in pages:
+                self.allocator.release(p)
+            for p in h.pages:
+                self.src_allocator.release(p)
+            return False
+        self.slots[h.rid] = pages
+        return True

@@ -242,6 +242,123 @@ class TestConcurrencyTier:
         assert "selects nothing" in err
 
 
+class TestOwnershipTier:
+    def test_tier_finds_ownership_bugs(self, capsys):
+        rc, out, err = run_cli(
+            capsys, str(FIXTURES / "bad_ownership.py"), "--no-baseline",
+            "--tier", "ownership",
+        )
+        assert rc == 1
+        assert "ST1101" in out and "ST1105" in out
+        assert "[ownership]" in err
+
+    def test_tier_runs_only_st11_family(self, capsys):
+        # bad_sharding.py is full of ST1xx AST findings, none run here
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_sharding.py"), "--no-baseline",
+            "--tier", "ownership",
+        )
+        assert rc == 0 and out == ""
+
+    def test_three_tier_composition_single_process(self, capsys):
+        """--tier ast,concurrency,ownership runs all three pools in one
+        invocation: AST, ST9xx and ST11xx findings all surface."""
+        rc, out, _ = run_cli(
+            capsys,
+            str(FIXTURES / "bad_sharding.py"),
+            str(FIXTURES / "bad_concurrency.py"),
+            str(FIXTURES / "bad_ownership.py"),
+            "--no-baseline", "--tier", "ast,concurrency,ownership",
+        )
+        assert rc == 1
+        assert "ST101" in out and "ST901" in out and "ST1101" in out
+
+    def test_three_tier_composition_clean(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "clean_ownership.py"), "--no-baseline",
+            "--tier", "ast,concurrency,ownership",
+        )
+        assert rc == 0 and out == ""
+
+    def test_st11_family_points_at_ownership_tier(self, capsys):
+        """ST11/ST1101 are ownership-tier codes — like ST7/ST10,
+        selecting them must point at the tier, and ST11 must NOT parse
+        as the ST1 sharding family."""
+        for sel in ("ST11", "st1101"):
+            rc, _, err = run_cli(
+                capsys, str(FIXTURES / "clean.py"), "--select", sel,
+            )
+            assert rc == 2, sel
+            assert "--tier ownership" in err, (sel, err)
+
+    def test_select_by_pass_name_works_from_default_tier(self, capsys):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_ownership.py"), "--no-baseline",
+            "--select", "ownership",
+        )
+        assert rc == 1 and "ST1101" in out
+
+    def test_foreign_select_inside_tier_is_usage_error(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"),
+            "--tier", "ownership", "--select", "sharding",
+        )
+        assert rc == 2
+        assert "selects nothing" in err
+
+    def test_unknown_tier_listing_includes_ownership(self, capsys):
+        rc, _, err = run_cli(
+            capsys, str(FIXTURES / "clean.py"), "--tier", "nonsense",
+        )
+        assert rc == 2
+        assert "ownership" in err
+
+
+class TestSarifFormat:
+    def _sarif(self, capsys, *extra):
+        rc, out, _ = run_cli(
+            capsys, str(FIXTURES / "bad_ownership.py"), "--no-baseline",
+            "--tier", "ownership", "--format", "sarif", *extra,
+        )
+        return rc, out
+
+    def test_shape(self, capsys):
+        rc, out = self._sarif(capsys)
+        doc = json.loads(out)
+        assert rc == 1
+        assert doc["version"] == "2.1.0"
+        driver = doc["runs"][0]["tool"]["driver"]
+        assert driver["name"] == "jaxlint"
+        results = doc["runs"][0]["results"]
+        assert results
+        r = results[0]
+        assert r["ruleId"].startswith("ST11")
+        assert r["level"] == "error"
+        loc = r["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"].endswith("bad_ownership.py")
+        assert loc["region"]["startLine"] >= 1
+        rule_ids = [rule["id"] for rule in driver["rules"]]
+        assert rule_ids == sorted(set(rule_ids))
+
+    def test_byte_stable_across_runs(self, capsys):
+        """No timestamps or dict-order jitter: two runs over the same
+        tree must produce identical bytes (CI artifact diffing)."""
+        _, first = self._sarif(capsys)
+        _, second = self._sarif(capsys)
+        assert first == second
+
+    def test_clean_run_is_valid_empty_sarif(self, capsys):
+        rc, out, err = run_cli(
+            capsys, str(FIXTURES / "clean_ownership.py"), "--no-baseline",
+            "--tier", "ownership", "--format", "sarif",
+        )
+        doc = json.loads(out)
+        assert rc == 0
+        assert doc["runs"][0]["results"] == []
+        # summary line would corrupt a redirected .sarif file
+        assert "jaxlint:" not in err
+
+
 class TestGithubFormat:
     def test_error_and_warning_annotations(self, capsys):
         rc, out, _ = run_cli(

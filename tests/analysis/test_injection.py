@@ -192,6 +192,85 @@ class TestInjectedUnlockedReap:
         assert findings == [], [f.render() for f in findings]
 
 
+class TestInjectedRetireLeak:
+    """ST1101 catches a deleted release in the REAL retire path: without
+    the `self.allocator.release(p)` loop, `_retire_slot` empties the
+    owning `_slot_pages[i]` container and the slot's pages leak from the
+    pool — the exact conservation bug `check_conservation` would only
+    catch at runtime."""
+
+    COMPANIONS = ["inference/kv_cache.py"]
+    SRC = PKG / "inference" / "engine.py"
+    NEEDLE = (
+        "            for p in self._slot_pages[i]:\n"
+        "                self.allocator.release(p)\n"
+    )
+
+    def _ownership(self, tmp_path, src):
+        mutated = tmp_path / "engine.py"
+        mutated.write_text(src, encoding="utf-8")
+        paths = [str(mutated)] + [str(PKG / c) for c in self.COMPANIONS]
+        modules, errors = collect_files(paths)
+        assert not errors
+        return analyze(modules, select=["ownership"])
+
+    def test_deleted_release_loop_detected(self, tmp_path):
+        src = self.SRC.read_text()
+        assert self.NEEDLE in src, "_retire_slot release moved; update test"
+        findings = self._ownership(tmp_path, src.replace(self.NEEDLE, "", 1))
+        assert [f.code for f in findings] == ["ST1101"], \
+            [f.render() for f in findings]
+        assert "_slot_pages" in findings[0].message
+
+    def test_unmutated_engine_is_clean(self, tmp_path):
+        findings = self._ownership(tmp_path, self.SRC.read_text())
+        assert findings == [], [f.render() for f in findings]
+
+
+class TestInjectedRollbackInversion:
+    """ST1105 catches the PR 19 rollback discipline inverted in the REAL
+    handoff: releasing the prefill side's pages (the transfer source,
+    `h.pages`) before the decode side's fresh reservation (`pages`)
+    breaks destination-before-source — a second fault between the two
+    loops orphans pages that still have a live owner."""
+
+    COMPANIONS = ["inference/engine.py", "inference/kv_cache.py"]
+    SRC = PKG / "inference" / "disagg.py"
+    HEALTHY = (
+        "            for p in pages:\n"
+        "                self.allocator.release(p)\n"
+        "            for p in h.pages:\n"
+        "                self.prefill_allocator.release(p)\n"
+    )
+    SWAPPED = (
+        "            for p in h.pages:\n"
+        "                self.prefill_allocator.release(p)\n"
+        "            for p in pages:\n"
+        "                self.allocator.release(p)\n"
+    )
+
+    def _ownership(self, tmp_path, src):
+        mutated = tmp_path / "disagg.py"
+        mutated.write_text(src, encoding="utf-8")
+        paths = [str(mutated)] + [str(PKG / c) for c in self.COMPANIONS]
+        modules, errors = collect_files(paths)
+        assert not errors
+        return analyze(modules, select=["ownership"])
+
+    def test_inverted_rollback_order_detected(self, tmp_path):
+        src = self.SRC.read_text()
+        assert self.HEALTHY in src, "_try_handoff rollback moved; update test"
+        findings = self._ownership(
+            tmp_path, src.replace(self.HEALTHY, self.SWAPPED, 1))
+        assert [f.code for f in findings] == ["ST1105"], \
+            [f.render() for f in findings]
+        assert "h.pages" in findings[0].message
+
+    def test_unmutated_disagg_is_clean(self, tmp_path):
+        findings = self._ownership(tmp_path, self.SRC.read_text())
+        assert findings == [], [f.render() for f in findings]
+
+
 class TestRepoGate:
     def test_package_and_tools_lint_clean_with_baseline(self):
         """The exact CI gate: repo findings minus baseline is empty."""
@@ -220,6 +299,23 @@ class TestRepoGate:
         try:
             rc = main(["--tier", "concurrency", "scaletorch_tpu/",
                        "tools/"])
+        finally:
+            os.chdir(cwd)
+        out = capsys.readouterr().out
+        assert rc == 0 and out == "", out
+
+    def test_ownership_tier_cli_gate(self, capsys):
+        """The exact CI invocation: `--tier ownership` exits 0 with zero
+        findings over the package, tools and scripts."""
+        import os
+
+        from scaletorch_tpu.analysis.__main__ import main
+
+        cwd = os.getcwd()
+        os.chdir(REPO)
+        try:
+            rc = main(["--tier", "ownership", "scaletorch_tpu/", "tools/",
+                       "scripts/"])
         finally:
             os.chdir(cwd)
         out = capsys.readouterr().out

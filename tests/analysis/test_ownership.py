@@ -1,0 +1,112 @@
+"""Ownership tier (ST11xx): seeded-fixture anchors and the clean-shape
+zero-false-positive bar.
+
+``bad_ownership.py`` carries exactly one bug per site; the (code, line)
+pairs here pin both that each detector fires and that nothing else
+does.  ``clean_ownership.py`` holds the idiomatic shapes from the real
+serving path (retain/rollback, try/finally, owning stores, funnels,
+span wrappers, daemon threads) and must stay at zero findings.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from scaletorch_tpu.analysis import analyze, collect_files, resolve_select
+
+FIXTURES = Path(__file__).resolve().parent / "fixtures"
+
+
+def _ownership_findings(name):
+    modules, errors = collect_files([str(FIXTURES / name)])
+    assert errors == [], [f.render() for f in errors]
+    return analyze(modules, select=["ownership"])
+
+
+@pytest.fixture(scope="module")
+def bad_findings():
+    return _ownership_findings("bad_ownership.py")
+
+
+class TestSeededViolations:
+    EXPECTED = [
+        ("ST1101", 36),   # alloc leaks on the early "too big" return
+        ("ST1102", 51),   # second release loop on the same path
+        ("ST1101", 62),   # slot cleared with no preceding release loop
+        ("ST1101", 71),   # open() never closed
+        ("ST1101", 77),   # socket never closed
+        ("ST1101", 84),   # local thread started, never joined/stored
+        ("ST1101", 96),   # stored thread: no method of the class joins it
+        ("ST1103", 112),  # terminal store outside the funnel
+        ("ST1103", 113),  # terminal call outside the funnel
+        ("ST1104", 121),  # span begun, never ended
+        ("ST1104", 124),  # span ended, never begun
+        ("ST1105", 151),  # rollback releases source before destination
+    ]
+
+    def test_exact_codes_and_lines(self, bad_findings):
+        got = [(f.code, f.line) for f in bad_findings]
+        assert got == self.EXPECTED, [f.render() for f in bad_findings]
+
+    def test_file_attribution(self, bad_findings):
+        assert all(
+            f.file.endswith("bad_ownership.py") for f in bad_findings
+        )
+
+    def test_leak_message_names_acquirer_and_exit(self, bad_findings):
+        msg = bad_findings[0].message
+        assert "self.allocator.alloc" in msg
+        assert "line 40" in msg
+
+    def test_double_release_names_acquire_site(self, bad_findings):
+        (msg,) = [f.message for f in bad_findings if f.code == "ST1102"]
+        assert "already released" in msg
+        assert "line 46" in msg
+
+    def test_empty_store_names_the_container(self, bad_findings):
+        (msg,) = [
+            f.message for f in bad_findings
+            if f.code == "ST1101" and f.line == 62
+        ]
+        assert "_slot_pages" in msg
+        assert "release loop" in msg
+
+    def test_funnel_messages_name_the_funnel(self, bad_findings):
+        msgs = [f.message for f in bad_findings if f.code == "ST1103"]
+        assert len(msgs) == 2
+        assert all("_finalize" in m and "shortcut" in m for m in msgs)
+
+    def test_span_messages_name_the_span(self, bad_findings):
+        msgs = [f.message for f in bad_findings if f.code == "ST1104"]
+        assert any("fx.work" in m for m in msgs)
+        assert any("fx.gone" in m for m in msgs)
+
+    def test_rollback_message_names_both_allocators(self, bad_findings):
+        (msg,) = [f.message for f in bad_findings if f.code == "ST1105"]
+        assert "self.src_allocator.release" in msg
+        assert "self.allocator.release" in msg
+        assert "h.pages" in msg
+
+    def test_severity_is_error(self, bad_findings):
+        assert {f.severity for f in bad_findings} == {"error"}
+
+
+class TestCleanShapes:
+    def test_zero_findings(self):
+        findings = _ownership_findings("clean_ownership.py")
+        assert findings == [], [f.render() for f in findings]
+
+
+class TestSelectRouting:
+    def test_st11_family_points_at_the_tier(self):
+        with pytest.raises(ValueError) as exc:
+            resolve_select(["ST11"])
+        assert "--tier ownership" in str(exc.value)
+
+    def test_st11_code_points_at_the_tier(self):
+        with pytest.raises(ValueError) as exc:
+            resolve_select(["ST1101"])
+        assert "--tier ownership" in str(exc.value)
+
+    def test_ownership_is_a_valid_pass_name(self):
+        assert resolve_select(["ownership"]) == ["ownership"]

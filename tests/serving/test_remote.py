@@ -136,6 +136,25 @@ class TestWireInProcess:
             remote.stop_polling()
             srv.stop()
 
+    def test_stop_polling_joins_the_poller(self):
+        """stop_polling must wait for the poller thread, not just flag
+        it — a replaced worker's poller may not outlive its successor
+        (the ST1101 finding that seeded the ownership tier)."""
+        worker = FakeEngineWorker(token_delay_s=0.0)
+        srv = ServerThread(worker).start()
+        remote = RemoteEngineWorker(
+            "127.0.0.1", srv.port, replica_id="r0").start()
+        try:
+            assert remote._poller.is_alive()
+        finally:
+            remote.stop_polling()
+            srv.stop()
+        assert not remote._poller.is_alive()
+        # before start() the poller has no ident: stop must not raise
+        fresh = RemoteEngineWorker("127.0.0.1", srv.port, replica_id="rx")
+        fresh.stop_polling()
+        assert not fresh._poller.is_alive()
+
     def test_trace_id_rides_the_hop(self):
         worker = FakeEngineWorker(token_delay_s=0.0)
         srv = ServerThread(worker).start()
